@@ -1,0 +1,8 @@
+from raydp_tpu.config.config import (
+    ClusterConfig,
+    DataConfig,
+    TrainConfig,
+    validate_config,
+)
+
+__all__ = ["ClusterConfig", "DataConfig", "TrainConfig", "validate_config"]

@@ -1,0 +1,118 @@
+"""Typed, validated configuration for the framework.
+
+The reference threads untyped string dicts into SparkConf with reserved magic
+keys (reference: python/raydp/context.py:55-56,105-110 and
+ray_cluster_master.py:146-167 — JSON → JVM system properties). Here config is
+dataclasses with validation at construction, plus a single escape-hatch
+``extra`` dict for forward-compatible knobs.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from raydp_tpu.parallel.mesh import MeshSpec
+from raydp_tpu.utils.memory import parse_memory_size
+
+PLACEMENT_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+
+
+@dataclass
+class ClusterConfig:
+    """ETL worker-pool + placement configuration (``raydp_tpu.init`` arg).
+
+    Mirrors the capability surface of ``raydp.init_spark``
+    (reference: python/raydp/context.py:154-205): app name, worker count,
+    per-worker cores/memory, placement strategy, free-form configs.
+    """
+
+    app_name: str = "raydp-tpu"
+    num_workers: int = 2
+    cores_per_worker: int = 1
+    memory_per_worker: int = 1 * 1024**3  # bytes; str accepted via from_args
+    placement_strategy: Optional[str] = None
+    placement_group: Optional[Any] = None  # pre-created PlacementGroup
+    placement_bundle_indexes: Optional[list] = None
+    enable_native: bool = True  # use the C++ data-plane library when built
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    @staticmethod
+    def from_args(
+        app_name: str = "raydp-tpu",
+        num_workers: int = 2,
+        cores_per_worker: int = 1,
+        memory_per_worker: "int | str" = "1GB",
+        placement_strategy: Optional[str] = None,
+        placement_group: Optional[Any] = None,
+        placement_bundle_indexes: Optional[list] = None,
+        enable_native: bool = True,
+        configs: Optional[Dict[str, Any]] = None,
+    ) -> "ClusterConfig":
+        cfg = ClusterConfig(
+            app_name=app_name,
+            num_workers=num_workers,
+            cores_per_worker=cores_per_worker,
+            memory_per_worker=parse_memory_size(memory_per_worker),
+            placement_strategy=placement_strategy,
+            placement_group=placement_group,
+            placement_bundle_indexes=placement_bundle_indexes,
+            enable_native=enable_native,
+            extra=dict(configs or {}),
+        )
+        validate_config(cfg)
+        return cfg
+
+
+@dataclass
+class DataConfig:
+    """Ingest/shard settings for MLDataset and the device infeed."""
+
+    batch_size: int = 256
+    shuffle: bool = False
+    shuffle_seed: Optional[int] = None
+    prefetch: int = 2  # host-side batches staged ahead of the device
+    max_rows_per_block: int = 1 << 20
+    drop_last: bool = False
+
+    def __post_init__(self):
+        if self.batch_size <= 0:
+            raise ValueError("batch_size must be positive")
+        if self.prefetch < 0:
+            raise ValueError("prefetch must be >= 0")
+
+
+@dataclass
+class TrainConfig:
+    """Estimator training-loop settings."""
+
+    num_epochs: int = 1
+    mesh: MeshSpec = field(default_factory=MeshSpec)
+    seed: int = 0
+    log_every_steps: int = 50
+    checkpoint_dir: Optional[str] = None
+    max_failures: int = 3  # step-level retry budget (parity with Ray Train's
+    # max_retries; reference: python/raydp/torch/estimator.py:269)
+
+    def __post_init__(self):
+        if self.num_epochs <= 0:
+            raise ValueError("num_epochs must be positive")
+
+
+def validate_config(cfg: ClusterConfig) -> None:
+    if cfg.num_workers < 0:
+        raise ValueError("num_workers must be >= 0")
+    if cfg.cores_per_worker <= 0:
+        raise ValueError("cores_per_worker must be positive")
+    if cfg.memory_per_worker <= 0:
+        raise ValueError("memory_per_worker must be positive")
+    if cfg.placement_strategy is not None:
+        if cfg.placement_strategy not in PLACEMENT_STRATEGIES:
+            raise ValueError(
+                f"placement_strategy must be one of {PLACEMENT_STRATEGIES}, "
+                f"got {cfg.placement_strategy!r}"
+            )
+    if cfg.placement_group is not None and cfg.placement_strategy is not None:
+        raise ValueError(
+            "pass either a pre-created placement_group or a "
+            "placement_strategy, not both"
+        )

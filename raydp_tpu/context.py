@@ -1,0 +1,110 @@
+"""Framework lifecycle: ``init()`` / ``stop()``.
+
+Semantics parity with the reference's context management
+(reference: python/raydp/context.py:150-217): process-wide singleton guarded
+by an RLock, re-init raises unless the previous session was stopped, atexit
+teardown, and ``stop(del_obj_holder=False)`` keeps converted data alive in
+the object store after the ETL workers are torn down (ownership transfer —
+the holder outlives the cluster).
+"""
+from __future__ import annotations
+
+import atexit
+import threading
+from typing import Any, Dict, Optional
+
+from raydp_tpu.config import ClusterConfig
+
+_lock = threading.RLock()
+_session: Optional["Session"] = None
+
+
+class Session:
+    """A live ETL-worker cluster + object store + (optional) TPU mesh."""
+
+    def __init__(self, cfg: ClusterConfig):
+        from raydp_tpu.cluster.cluster import Cluster
+
+        self.config = cfg
+        self.cluster = Cluster(cfg)
+        self.cluster.start()
+        self._stopped = False
+
+    @property
+    def stopped(self) -> bool:
+        return self._stopped
+
+    def stop(self, del_obj_holder: bool = True) -> None:
+        if self._stopped:
+            return
+        self.cluster.shutdown(del_obj_holder=del_obj_holder)
+        self._stopped = True
+
+
+def init(
+    app_name: str = "raydp-tpu",
+    num_workers: int = 2,
+    cores_per_worker: int = 1,
+    memory_per_worker: "int | str" = "1GB",
+    placement_strategy: Optional[str] = None,
+    placement_group: Optional[Any] = None,
+    placement_bundle_indexes: Optional[list] = None,
+    enable_native: bool = True,
+    configs: Optional[Dict[str, Any]] = None,
+) -> Session:
+    """Start the distributed ETL + training session (singleton).
+
+    Raises if a live session already exists (same re-init guard as the
+    reference: python/raydp/context.py:176-184).
+    """
+    global _session
+    with _lock:
+        if _session is not None and not _session.stopped:
+            raise RuntimeError(
+                "a raydp_tpu session is already running; call "
+                "raydp_tpu.stop() first"
+            )
+        cfg = ClusterConfig.from_args(
+            app_name=app_name,
+            num_workers=num_workers,
+            cores_per_worker=cores_per_worker,
+            memory_per_worker=memory_per_worker,
+            placement_strategy=placement_strategy,
+            placement_group=placement_group,
+            placement_bundle_indexes=placement_bundle_indexes,
+            enable_native=enable_native,
+            configs=configs,
+        )
+        _session = Session(cfg)
+        return _session
+
+
+def stop(del_obj_holder: bool = True) -> None:
+    """Stop the session. With ``del_obj_holder=False`` the object-store
+    holder keeps owned objects alive for later reads."""
+    global _session
+    with _lock:
+        if _session is not None:
+            _session.stop(del_obj_holder=del_obj_holder)
+            if del_obj_holder:
+                _session = None
+
+
+def current_session() -> Optional[Session]:
+    with _lock:
+        return _session if (_session and not _session.stopped) else None
+
+
+def require_session() -> Session:
+    s = current_session()
+    if s is None:
+        raise RuntimeError("no live session; call raydp_tpu.init() first")
+    return s
+
+
+@atexit.register
+def _atexit_stop() -> None:
+    try:
+        stop()
+    except Exception:
+        pass

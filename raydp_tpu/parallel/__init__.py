@@ -1,0 +1,17 @@
+from raydp_tpu.parallel.mesh import (
+    AXIS_ORDER,
+    DEFAULT_LOGICAL_RULES,
+    MeshSpec,
+    factor_devices,
+    logical_to_spec,
+    named_sharding,
+)
+
+__all__ = [
+    "AXIS_ORDER",
+    "DEFAULT_LOGICAL_RULES",
+    "MeshSpec",
+    "factor_devices",
+    "logical_to_spec",
+    "named_sharding",
+]

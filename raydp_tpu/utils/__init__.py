@@ -1,0 +1,19 @@
+from raydp_tpu.utils.memory import format_memory_size, parse_memory_size
+from raydp_tpu.utils.net import find_free_port, local_ip
+from raydp_tpu.utils.sharding import (
+    BlockSlice,
+    assignment_sample_counts,
+    divide_blocks,
+    split_sizes,
+)
+
+__all__ = [
+    "parse_memory_size",
+    "format_memory_size",
+    "find_free_port",
+    "local_ip",
+    "BlockSlice",
+    "divide_blocks",
+    "assignment_sample_counts",
+    "split_sizes",
+]

@@ -1,0 +1,53 @@
+"""Human-readable memory size parsing/formatting.
+
+Capability parity with the reference's memory-string handling
+(reference: python/raydp/utils.py:125-146 ``parse_memory_size``): accepts
+"500M", "500MB", "1.5 GB", "2g", plain integers ("1024"), case-insensitive,
+optional space between number and unit.
+"""
+from __future__ import annotations
+
+import re
+
+_UNIT_BYTES = {
+    "": 1,
+    "K": 2**10,
+    "M": 2**20,
+    "G": 2**30,
+    "T": 2**40,
+    "P": 2**50,
+}
+
+_MEM_RE = re.compile(r"^\s*([0-9]*\.?[0-9]+)\s*([KMGTP]?)I?B?\s*$", re.IGNORECASE)
+
+
+def parse_memory_size(size: "str | int | float") -> int:
+    """Parse a human-readable memory size into bytes.
+
+    >>> parse_memory_size("500MB")
+    524288000
+    >>> parse_memory_size("1.5 G")
+    1610612736
+    >>> parse_memory_size(1024)
+    1024
+    """
+    if isinstance(size, (int, float)):
+        return int(size)
+    m = _MEM_RE.match(size)
+    if not m:
+        raise ValueError(f"cannot parse memory size: {size!r}")
+    number, unit = m.group(1), m.group(2).upper()
+    return int(float(number) * _UNIT_BYTES[unit])
+
+
+def format_memory_size(num_bytes: int) -> str:
+    """Format bytes as a short human-readable string ("1.5GB")."""
+    if num_bytes < 0:
+        raise ValueError("negative size")
+    for unit in ("P", "T", "G", "M", "K"):
+        scale = _UNIT_BYTES[unit]
+        if num_bytes >= scale:
+            value = num_bytes / scale
+            text = f"{value:.1f}".rstrip("0").rstrip(".")
+            return f"{text}{unit}B"
+    return f"{num_bytes}B"

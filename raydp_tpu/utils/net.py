@@ -1,0 +1,21 @@
+"""Small networking helpers for the control plane."""
+from __future__ import annotations
+
+import socket
+
+
+def find_free_port(host: str = "127.0.0.1") -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+def local_ip() -> str:
+    """Best-effort non-loopback IP of this host (falls back to 127.0.0.1)."""
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            # No packet is sent; connect() on UDP just selects a route.
+            s.connect(("8.8.8.8", 80))
+            return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"

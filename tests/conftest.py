@@ -1,0 +1,41 @@
+"""Test configuration: run JAX on a virtual 8-device CPU mesh.
+
+The reference tests against a real local Ray cluster in two client modes
+(reference: python/raydp/tests/conftest.py:34-59). Here the equivalent
+"real runtime on one host" is: XLA CPU backend forced to expose 8 devices so
+multi-chip collectives (psum over dp, ring attention over sp, tensor-parallel
+matmuls over tp) execute for real in every test, without TPU hardware.
+
+bench.py and production code never import this — only pytest does.
+"""
+import os
+
+# Must be set before jax (transitively) imports. Hard-set (not setdefault):
+# the environment presets JAX_PLATFORMS=axon (real TPU) which tests must not
+# grab — the single real chip can't host 8-device mesh tests.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("RAYDP_TPU_TEST_MODE", "1")
+
+# The image's sitecustomize imports jax at interpreter startup (to register
+# the axon TPU PJRT plugin), so the env vars above are read too late by the
+# already-imported jax config. Backend *initialization* is still lazy, so
+# flipping the config here (before any jax.devices() call) wins.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_cpu_devices():
+    import jax
+
+    devices = jax.devices()
+    assert len(devices) >= 8, f"expected >=8 virtual devices, got {len(devices)}"
+    return devices

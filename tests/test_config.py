@@ -1,0 +1,62 @@
+import pytest
+
+from raydp_tpu.config import ClusterConfig, DataConfig, TrainConfig
+from raydp_tpu.parallel import MeshSpec, factor_devices, logical_to_spec
+
+
+def test_cluster_config_from_args():
+    cfg = ClusterConfig.from_args(num_workers=3, memory_per_worker="512MB")
+    assert cfg.memory_per_worker == 512 * 1024**2
+    assert cfg.num_workers == 3
+
+
+def test_cluster_config_validation():
+    with pytest.raises(ValueError):
+        ClusterConfig.from_args(num_workers=-1)
+    with pytest.raises(ValueError):
+        ClusterConfig.from_args(placement_strategy="DIAGONAL")
+    with pytest.raises(ValueError):
+        ClusterConfig.from_args(placement_strategy="PACK", placement_group=object())
+
+
+def test_data_config_validation():
+    with pytest.raises(ValueError):
+        DataConfig(batch_size=0)
+    assert DataConfig(batch_size=8).prefetch == 2
+
+
+def test_train_config_defaults():
+    tc = TrainConfig()
+    assert tc.mesh.size == 1
+
+
+def test_mesh_spec_build(eight_cpu_devices):
+    spec = MeshSpec(dp=2, tp=2, sp=2)
+    assert spec.size == 8
+    mesh = spec.build()
+    assert mesh.shape == {"dp": 2, "pp": 1, "sp": 2, "tp": 2}
+
+
+def test_mesh_spec_too_big(eight_cpu_devices):
+    with pytest.raises(ValueError):
+        MeshSpec(dp=64).build()
+
+
+def test_factor_devices():
+    spec = factor_devices(8)
+    assert spec.size == 8
+    assert spec.tp == 2 and spec.sp == 2
+    assert factor_devices(1).size == 1
+    assert factor_devices(6).size == 6
+
+
+def test_logical_to_spec(eight_cpu_devices):
+    from jax.sharding import PartitionSpec
+
+    mesh = MeshSpec(dp=2, tp=2, sp=2).build()
+    spec = logical_to_spec(["batch", "sequence", "hidden"], mesh=mesh)
+    assert spec == PartitionSpec("dp", "sp")
+    # trailing Nones trimmed; trivial axes dropped
+    mesh1 = MeshSpec(dp=8).build()
+    spec1 = logical_to_spec(["batch", "heads", "mlp"], mesh=mesh1)
+    assert spec1 == PartitionSpec("dp")

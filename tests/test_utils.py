@@ -1,0 +1,79 @@
+"""Unit tests for utils: memory parsing and block-division invariants
+(test-shape parity with reference python/raydp/tests/test_spark_utils.py)."""
+import math
+
+import pytest
+
+from raydp_tpu.utils import (
+    assignment_sample_counts,
+    divide_blocks,
+    format_memory_size,
+    parse_memory_size,
+    split_sizes,
+)
+
+
+@pytest.mark.parametrize(
+    "text,expected",
+    [
+        ("1024", 1024),
+        ("1K", 1024),
+        ("1KB", 1024),
+        ("1 kb", 1024),
+        ("500M", 500 * 1024**2),
+        ("500MB", 500 * 1024**2),
+        ("1.5G", int(1.5 * 1024**3)),
+        ("2g", 2 * 1024**3),
+        ("3T", 3 * 1024**4),
+        (2048, 2048),
+    ],
+)
+def test_parse_memory_size(text, expected):
+    assert parse_memory_size(text) == expected
+
+
+def test_parse_memory_size_rejects_garbage():
+    with pytest.raises(ValueError):
+        parse_memory_size("lots")
+    with pytest.raises(ValueError):
+        parse_memory_size("12X")
+
+
+def test_format_roundtrip():
+    assert parse_memory_size(format_memory_size(1536 * 1024**2)) == 1536 * 1024**2
+    assert format_memory_size(100) == "100B"
+
+
+@pytest.mark.parametrize("world_size", [1, 2, 3, 5, 8])
+@pytest.mark.parametrize("shuffle", [False, True])
+def test_divide_blocks_equal_samples(world_size, shuffle):
+    blocks = [10, 5, 8, 1, 13, 2, 2, 7, 9, 4]
+    total = sum(blocks)
+    per_rank = math.ceil(total / world_size)
+    assignment = divide_blocks(blocks, world_size, shuffle=shuffle, shuffle_seed=42)
+    assert set(assignment) == set(range(world_size))
+    counts = assignment_sample_counts(assignment)
+    for rank in range(world_size):
+        assert counts[rank] == per_rank
+        for s in assignment[rank]:
+            assert 0 < s.num_samples <= blocks[s.block_index]
+
+
+def test_divide_blocks_deterministic():
+    blocks = [4, 4, 4, 7]
+    a = divide_blocks(blocks, 2, shuffle=True, shuffle_seed=7)
+    b = divide_blocks(blocks, 2, shuffle=True, shuffle_seed=7)
+    assert a == b
+    c = divide_blocks(blocks, 2, shuffle=True, shuffle_seed=8)
+    assert a != c  # overwhelmingly likely
+
+
+def test_divide_blocks_not_enough_blocks():
+    with pytest.raises(ValueError):
+        divide_blocks([5], 2)
+
+
+def test_split_sizes():
+    assert split_sizes(10, 3) == (4, 3, 3)
+    assert sum(split_sizes(17, 5)) == 17
+    assert split_sizes(2, 4) == (1, 1, 0, 0)
