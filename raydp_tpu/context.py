@@ -28,17 +28,25 @@ class Session:
         self.config = cfg
         self.cluster = Cluster(cfg)
         self.cluster.start()
-        self._stopped = False
+        self._workers_stopped = False
+        self._holder_released = False
 
     @property
     def stopped(self) -> bool:
-        return self._stopped
+        """Workers down — the session no longer blocks a new init()."""
+        return self._workers_stopped
 
     def stop(self, del_obj_holder: bool = True) -> None:
-        if self._stopped:
-            return
-        self.cluster.shutdown(del_obj_holder=del_obj_holder)
-        self._stopped = True
+        """Idempotent, two-phase: workers stop once; the object holder can
+        be released later by a second ``stop(del_obj_holder=True)`` after a
+        ``stop(del_obj_holder=False)`` (else holder segments would leak)."""
+        if not self._workers_stopped:
+            self.cluster.shutdown(del_obj_holder=del_obj_holder)
+            self._workers_stopped = True
+            self._holder_released = del_obj_holder
+        elif del_obj_holder and not self._holder_released:
+            self.cluster.release_holder()
+            self._holder_released = True
 
 
 def init(
@@ -88,6 +96,8 @@ def stop(del_obj_holder: bool = True) -> None:
             _session.stop(del_obj_holder=del_obj_holder)
             if del_obj_holder:
                 _session = None
+        # del_obj_holder=False keeps _session so a later stop() can still
+        # reach the holder and release its objects.
 
 
 def current_session() -> Optional[Session]:

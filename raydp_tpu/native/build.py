@@ -1,0 +1,74 @@
+"""Lazy build of the native data-plane library.
+
+Compiles raydp_tpu/native/src/*.cpp into libraydp_native.so with g++ the
+first time it's needed (or when sources are newer than the .so). No
+pybind11 in this image — the library is plain ``extern "C"`` + ctypes.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC_DIR = os.path.join(_HERE, "src")
+_LIB_PATH = os.path.join(_HERE, "libraydp_native.so")
+_lock = threading.Lock()
+
+
+def lib_path() -> str:
+    return _LIB_PATH
+
+
+def _sources() -> list:
+    if not os.path.isdir(_SRC_DIR):
+        return []
+    return sorted(
+        os.path.join(_SRC_DIR, f)
+        for f in os.listdir(_SRC_DIR)
+        if f.endswith(".cpp")
+    )
+
+
+def _stale() -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    return any(os.path.getmtime(s) > lib_mtime for s in _sources())
+
+
+def ensure_built(verbose: bool = False) -> Optional[str]:
+    """Build if needed; returns the .so path, or None if no toolchain."""
+    with _lock:
+        if not _stale():
+            return _LIB_PATH
+        srcs = _sources()
+        if not srcs:  # sources not shipped (e.g. wheel install) → fallback
+            return None
+        # Build to a process-private temp path, then atomically rename:
+        # concurrent worker processes may race here, and a peer must never
+        # dlopen a half-written .so.
+        tmp = f"{_LIB_PATH}.tmp.{os.getpid()}"
+        flag_sets = [
+            ["-O3", "-march=native", "-fopenmp"],
+            ["-O3"],  # -march=native / openmp may be unsupported
+        ]
+        try:
+            for flags in flag_sets:
+                cmd = ["g++", *flags, "-shared", "-fPIC", "-o", tmp, *srcs]
+                try:
+                    subprocess.run(
+                        cmd,
+                        check=True,
+                        capture_output=not verbose,
+                        timeout=120,
+                    )
+                except (subprocess.SubprocessError, FileNotFoundError):
+                    continue
+                os.replace(tmp, _LIB_PATH)
+                return _LIB_PATH
+            return None
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)

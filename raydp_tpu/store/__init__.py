@@ -1,0 +1,3 @@
+from raydp_tpu.store.object_store import OWNER_HOLDER, ObjectRef, ObjectStore
+
+__all__ = ["ObjectStore", "ObjectRef", "OWNER_HOLDER"]

@@ -1,0 +1,74 @@
+"""Native gather kernels vs numpy reference."""
+import numpy as np
+import pytest
+
+from raydp_tpu.native import lib as native
+
+
+def test_native_builds():
+    # The baked image has g++; the native path must actually build here.
+    assert native.native_available(), "native library failed to build"
+
+
+@pytest.mark.parametrize("out_dtype", [np.float32, np.int32])
+def test_gather_matrix_matches_numpy(out_dtype):
+    rng = np.random.default_rng(0)
+    n_src, n = 10_000, 4097
+    cols = [
+        rng.standard_normal(n_src).astype(np.float64),
+        rng.standard_normal(n_src).astype(np.float32),
+        rng.integers(-5, 100, n_src, dtype=np.int64),
+        rng.integers(0, 100, n_src, dtype=np.int32),
+        rng.integers(0, 100, n_src).astype(np.int16),
+        rng.integers(0, 200, n_src).astype(np.uint8),
+    ]
+    idx = rng.integers(0, n_src, n)
+    got = native.gather_matrix(cols, idx, out_dtype=out_dtype)
+    expect = np.stack(
+        [c[idx].astype(out_dtype) for c in cols], axis=1
+    )
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_gather_matrix_fallback_matches(monkeypatch):
+    rng = np.random.default_rng(1)
+    cols = [rng.standard_normal(100), rng.integers(0, 5, 100)]
+    idx = rng.integers(0, 100, 37)
+    native_out = native.gather_matrix(cols, idx)
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_lib_tried", True)
+    py_out = native.gather_matrix(cols, idx)
+    np.testing.assert_array_equal(native_out, py_out)
+
+
+def test_gather_rows():
+    rng = np.random.default_rng(2)
+    src = rng.standard_normal((1000, 17)).astype(np.float32)
+    idx = rng.integers(0, 1000, 256)
+    np.testing.assert_array_equal(native.gather_rows(src, idx), src[idx])
+
+
+def test_gather_matrix_rejects_bad_dtype():
+    with pytest.raises(ValueError):
+        native.gather_matrix([], np.array([0]))
+
+
+def test_gather_bounds_checked():
+    rng = np.random.default_rng(3)
+    cols = [rng.standard_normal(10)]
+    with pytest.raises(IndexError):
+        native.gather_matrix(cols, np.array([0, 10]))
+    with pytest.raises(IndexError):
+        native.gather_matrix(cols, np.array([-1]))
+    src = rng.standard_normal((10, 4)).astype(np.float32)
+    with pytest.raises(IndexError):
+        native.gather_rows(src, np.array([11]))
+
+
+def test_gather_matrix_rejects_noncontiguous_out():
+    rng = np.random.default_rng(4)
+    cols = [rng.standard_normal(10), rng.standard_normal(10)]
+    idx = np.arange(5)
+    bad_out = np.empty((2, 5), dtype=np.float32).T
+    with pytest.raises(ValueError):
+        native.gather_matrix(cols, idx, out=bad_out)
