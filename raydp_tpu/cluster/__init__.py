@@ -1,0 +1,18 @@
+from raydp_tpu.cluster.cluster import Cluster, ClusterError
+from raydp_tpu.cluster.placement import (
+    NodeInfo,
+    PlacementError,
+    PlacementGroup,
+    detect_nodes,
+    place,
+)
+
+__all__ = [
+    "Cluster",
+    "ClusterError",
+    "NodeInfo",
+    "PlacementError",
+    "PlacementGroup",
+    "detect_nodes",
+    "place",
+]

@@ -1,0 +1,301 @@
+"""Cluster facade: AppMaster + worker-pool lifecycle + task submission.
+
+Collapses the reference's Python/JVM control-plane sandwich
+(reference: python/raydp/spark/ray_cluster.py:30-97 SparkCluster,
+ray_cluster_master.py:36-196 RayDPSparkMaster spawning a JVM via py4j)
+into one component: the AppMaster runs in-process, workers are spawned as
+subprocesses of this driver, and everything speaks one gRPC protocol.
+
+Dynamic allocation parity (reference:
+RayCoarseGrainedSchedulerBackend.scala:219-242
+doRequestTotalExecutors/doKillExecutors): ``request_workers`` /
+``kill_worker`` grow and shrink the pool; shm objects survive worker
+death when holder-owned (the external-shuffle-service capability —
+shuffle state outliving executors — reference C16).
+"""
+from __future__ import annotations
+
+import itertools
+import logging
+import os
+import secrets
+import subprocess
+import sys
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional
+
+import cloudpickle
+
+from raydp_tpu.cluster import placement as pl
+from raydp_tpu.cluster.master import AppMaster, WorkerInfo
+from raydp_tpu.cluster.rpc import RpcClient
+from raydp_tpu.config import ClusterConfig
+
+logger = logging.getLogger(__name__)
+
+
+class ClusterError(RuntimeError):
+    pass
+
+
+class Cluster:
+    def __init__(self, config: ClusterConfig):
+        self.config = config
+        self.namespace = f"{_slug(config.app_name)}-{secrets.token_hex(3)}"
+        self.master: Optional[AppMaster] = None
+        self.pg: Optional[pl.PlacementGroup] = None
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._worker_clients: Dict[str, RpcClient] = {}
+        self._worker_seq = itertools.count()
+        self._rr = itertools.count()  # round-robin task cursor
+        self._lock = threading.RLock()
+        self._pool = ThreadPoolExecutor(max_workers=32)
+        self._log_dir = os.path.join(
+            "/tmp/raydp_tpu", f"{_slug(config.app_name)}-{os.getpid()}"
+        )
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> None:
+        os.makedirs(self._log_dir, exist_ok=True)
+        self.master = AppMaster(self.namespace)
+        try:
+            self._place_group()
+            self.master.expect_workers(self.config.num_workers)
+            for _ in range(self.config.num_workers):
+                self._spawn_worker()
+            if self.config.num_workers and not self.master.wait_for_workers(60.0):
+                raise ClusterError(
+                    f"workers failed to register within 60s "
+                    f"(logs: {self._log_dir})"
+                )
+        except BaseException:
+            # Partial start must not leak the master server/monitor thread.
+            self.shutdown(del_obj_holder=True)
+            raise
+        logger.info(
+            "cluster %s up: %d workers, master @ %s",
+            self.namespace,
+            self.config.num_workers,
+            self.master.address,
+        )
+
+    def _place_group(self) -> None:
+        if self.config.placement_group is not None:
+            self.pg = self.config.placement_group
+            return
+        if self.config.placement_strategy is None:
+            self.pg = None
+            return
+        bundles = [
+            {
+                "cpu": float(self.config.cores_per_worker),
+                "memory": float(self.config.memory_per_worker),
+            }
+            for _ in range(self.config.num_workers)
+        ]
+        self.pg = pl.place(
+            bundles, self.config.placement_strategy, self.master.nodes
+        )
+
+    def _bundle_node(self, index: int) -> str:
+        if self.pg is None:
+            return "node-0"
+        indexes = self.config.placement_bundle_indexes
+        if indexes is not None:
+            index = indexes[index % len(indexes)]
+        # Round-robin over bundles (reference: RayAppMaster.scala:281-289).
+        bundle = self.pg.bundles[index % len(self.pg.bundles)]
+        return bundle.node_id or "node-0"
+
+    def _spawn_worker(self) -> str:
+        seq = next(self._worker_seq)
+        worker_id = f"w{seq}"
+        node_id = self._bundle_node(seq)
+        log_path = os.path.join(self._log_dir, f"{worker_id}.log")
+        log_file = open(log_path, "ab")
+        proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "raydp_tpu.cluster.worker_main",
+                "--worker-id",
+                worker_id,
+                "--master",
+                self.master.address,
+                "--node-id",
+                node_id,
+                "--cores",
+                str(self.config.cores_per_worker),
+                "--memory",
+                str(self.config.memory_per_worker),
+            ],
+            stdout=log_file,
+            stderr=subprocess.STDOUT,
+            cwd=os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        log_file.close()
+        with self._lock:
+            self._procs[worker_id] = proc
+        return worker_id
+
+    def shutdown(self, del_obj_holder: bool = True) -> None:
+        """Stop workers; tear down master now (del_obj_holder=True) or keep
+        it + holder objects alive for later release_holder()."""
+        with self._lock:
+            worker_ids = list(self._procs)
+        for worker_id in worker_ids:
+            self._stop_worker(worker_id, kill_objects=False)
+        self._pool.shutdown(wait=False)
+        if self.master is not None:
+            if del_obj_holder:
+                self.release_holder()
+
+    def release_holder(self) -> None:
+        """Unlink holder-owned objects and stop the master service."""
+        if self.master is None:
+            return
+        self.master.release_holder()
+        self.master.store.destroy()
+        self.master.shutdown()
+        self.master = None
+
+    def _stop_worker(self, worker_id: str, kill_objects: bool = True) -> None:
+        client = self._client_for(worker_id)
+        if client is not None:
+            client.try_call("Stop", {}, timeout=2.0)
+            client.close()
+        with self._lock:
+            proc = self._procs.pop(worker_id, None)
+            self._worker_clients.pop(worker_id, None)
+        if proc is not None:
+            if client is None:
+                # Never registered (no RPC path) — don't wait out a
+                # heartbeat loop that won't stop; terminate directly.
+                proc.terminate()
+            try:
+                proc.wait(timeout=10 if client is not None else 2)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=5)
+        if kill_objects and self.master is not None:
+            self.master.mark_worker_dead(worker_id, reason="killed")
+
+    # -- dynamic allocation ---------------------------------------------
+    def request_workers(self, num_additional: int) -> List[str]:
+        """Grow the pool (dynamic allocation)."""
+        current = len(self.alive_workers())
+        self.master.expect_workers(current + num_additional)
+        ids = [self._spawn_worker() for _ in range(num_additional)]
+        if not self.master.wait_for_workers(60.0):
+            raise ClusterError("additional workers failed to register")
+        return ids
+
+    def kill_worker(self, worker_id: str) -> None:
+        """Shrink the pool; the worker's non-holder objects are unlinked,
+        holder-owned objects survive (shuffle-survival semantics)."""
+        self._stop_worker(worker_id, kill_objects=True)
+
+    # -- introspection ----------------------------------------------------
+    def alive_workers(self) -> List[WorkerInfo]:
+        return self.master.alive_workers() if self.master else []
+
+    def cluster_resources(self) -> dict:
+        return self.master.cluster_resources()
+
+    # -- task submission --------------------------------------------------
+    def submit(
+        self,
+        fn: Callable,
+        *args,
+        worker_id: Optional[str] = None,
+        timeout: float = 300.0,
+        **kwargs,
+    ) -> Any:
+        """Run ``fn(worker_ctx, *args, **kwargs)`` on one worker."""
+        return self.submit_async(
+            fn, *args, worker_id=worker_id, timeout=timeout, **kwargs
+        ).result()
+
+    def submit_async(
+        self,
+        fn: Callable,
+        *args,
+        worker_id: Optional[str] = None,
+        timeout: float = 300.0,
+        **kwargs,
+    ) -> Future:
+        target = self._pick_worker(worker_id)
+        payload = {
+            "fn": cloudpickle.dumps(fn),
+            "args": args,
+            "kwargs": kwargs,
+        }
+
+        def run():
+            import grpc
+
+            client = self._client_for(target)
+            if client is None:
+                raise ClusterError(f"worker {target} is gone")
+            try:
+                reply = client.call("RunTask", payload, timeout=timeout)
+            except grpc.RpcError as exc:
+                code = exc.code()
+                # Only connectivity loss means the worker is gone; a
+                # DEADLINE_EXCEEDED is a slow task on a healthy worker and
+                # must not unlink its objects.
+                if code == grpc.StatusCode.UNAVAILABLE and self.master is not None:
+                    self.master.mark_worker_dead(target, reason="worker unreachable")
+                raise ClusterError(
+                    f"task RPC to worker {target} failed: {code}"
+                ) from exc
+            return reply["result"]
+
+        return self._pool.submit(run)
+
+    def map_tasks(
+        self,
+        fn: Callable,
+        items: List[Any],
+        timeout: float = 300.0,
+    ) -> List[Any]:
+        """Run ``fn(ctx, item)`` for each item, load-balanced round-robin
+        over alive workers; preserves order."""
+        futures = [
+            self.submit_async(fn, item, timeout=timeout) for item in items
+        ]
+        return [f.result() for f in futures]
+
+    def _pick_worker(self, worker_id: Optional[str]) -> str:
+        workers = self.alive_workers()
+        if not workers:
+            raise ClusterError("no alive workers")
+        if worker_id is not None:
+            if not any(w.worker_id == worker_id for w in workers):
+                raise ClusterError(f"worker {worker_id} not alive")
+            return worker_id
+        return workers[next(self._rr) % len(workers)].worker_id
+
+    def _client_for(self, worker_id: str) -> Optional[RpcClient]:
+        with self._lock:
+            client = self._worker_clients.get(worker_id)
+            if client is not None:
+                return client
+        info = next(
+            (w for w in self.alive_workers() if w.worker_id == worker_id), None
+        )
+        if info is None:
+            return None
+        client = RpcClient(info.address, "raydp.Worker")
+        with self._lock:
+            winner = self._worker_clients.setdefault(worker_id, client)
+        if winner is not client:  # lost a create race; drop our channel
+            client.close()
+        return winner
+
+
+def _slug(name: str) -> str:
+    return "".join(c if c.isalnum() or c == "-" else "-" for c in name.lower())

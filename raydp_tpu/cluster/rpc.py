@@ -1,0 +1,145 @@
+"""Minimal gRPC layer: named bytes→bytes methods, pickle payloads.
+
+One control-plane transport replacing the reference's four (Spark RPC,
+Ray actor calls, py4j, gRPC — reference: SURVEY §2.4). Built on grpc's
+generic method handlers so no protoc codegen is needed (grpcio-tools is
+not in this image); messages are Python dicts pickled with cloudpickle
+(which also lets task payloads carry closures, the reference's MPI
+function-shipping pattern — reference: python/raydp/mpi/mpi_job.py:321-335).
+"""
+from __future__ import annotations
+
+import threading
+from concurrent import futures
+from typing import Any, Callable, Dict, Optional
+
+import cloudpickle
+import grpc
+
+
+def _identity(b: bytes) -> bytes:
+    return b
+
+
+class RpcError(RuntimeError):
+    """Remote handler raised; message carries the remote traceback."""
+
+
+class RpcServer:
+    """Hosts a service: a dict of ``{method_name: fn(dict) -> dict}``."""
+
+    def __init__(
+        self,
+        service_name: str,
+        handlers: Dict[str, Callable[[dict], dict]],
+        host: str = "127.0.0.1",
+        port: int = 0,
+        max_workers: int = 16,
+    ):
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers),
+            options=[
+                ("grpc.max_send_message_length", 512 * 1024 * 1024),
+                ("grpc.max_receive_message_length", 512 * 1024 * 1024),
+            ],
+        )
+        rpc_handlers = {
+            name: grpc.unary_unary_rpc_method_handler(
+                self._wrap(fn),
+                request_deserializer=_identity,
+                response_serializer=_identity,
+            )
+            for name, fn in handlers.items()
+        }
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(service_name, rpc_handlers),)
+        )
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        if self.port == 0:
+            raise RuntimeError(f"failed to bind {host}:{port}")
+        self.host = host
+        self._server.start()
+
+    @staticmethod
+    def _wrap(fn: Callable[[dict], dict]):
+        def handler(request_bytes: bytes, context) -> bytes:
+            try:
+                request = cloudpickle.loads(request_bytes)
+                reply = fn(request)
+                return cloudpickle.dumps({"ok": True, "value": reply})
+            except Exception as exc:  # ship the error to the caller
+                import traceback
+
+                return cloudpickle.dumps(
+                    {
+                        "ok": False,
+                        "error": f"{type(exc).__name__}: {exc}",
+                        "traceback": traceback.format_exc(),
+                    }
+                )
+
+        return handler
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def stop(self, grace: Optional[float] = 0.5) -> None:
+        self._server.stop(grace)
+
+
+class RpcClient:
+    """Calls methods on an RpcServer: ``client.call("Method", {...})``."""
+
+    def __init__(self, address: str, service_name: str, timeout: float = 30.0):
+        self.address = address
+        self._service = service_name
+        self._timeout = timeout
+        self._channel = grpc.insecure_channel(
+            address,
+            options=[
+                ("grpc.max_send_message_length", 512 * 1024 * 1024),
+                ("grpc.max_receive_message_length", 512 * 1024 * 1024),
+            ],
+        )
+        self._stubs: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def call(self, method: str, request: Optional[dict] = None, timeout=None):
+        with self._lock:
+            stub = self._stubs.get(method)
+            if stub is None:
+                stub = self._channel.unary_unary(
+                    f"/{self._service}/{method}",
+                    request_serializer=_identity,
+                    response_deserializer=_identity,
+                )
+                self._stubs[method] = stub
+        reply_bytes = stub(
+            cloudpickle.dumps(request or {}),
+            timeout=timeout if timeout is not None else self._timeout,
+        )
+        reply = cloudpickle.loads(reply_bytes)
+        if not reply.get("ok"):
+            raise RpcError(
+                f"remote {self._service}.{method} failed: "
+                f"{reply.get('error')}\n{reply.get('traceback', '')}"
+            )
+        return reply.get("value")
+
+    def try_call(self, method: str, request: Optional[dict] = None, timeout=None):
+        """Like call() but returns None on transport errors (peer gone)."""
+        try:
+            return self.call(method, request, timeout)
+        except (grpc.RpcError, RpcError):
+            return None
+
+    def wait_ready(self, timeout: float = 10.0) -> bool:
+        try:
+            grpc.channel_ready_future(self._channel).result(timeout=timeout)
+            return True
+        except grpc.FutureTimeoutError:
+            return False
+
+    def close(self) -> None:
+        self._channel.close()

@@ -1,0 +1,189 @@
+"""ETL worker process entry point.
+
+Role parity with the reference's executor backend
+(reference: core/.../executor/RayCoarseGrainedExecutorBackend.scala:38-262):
+a separately spawned process that registers with the AppMaster (with
+retries, :58-81), runs tasks shipped from the driver, heartbeats, and
+exits on Stop or on master disappearance.
+
+Tasks are cloudpickled callables ``fn(worker_ctx, *args)`` (the MPI
+subsystem's function-shipping design, reference:
+python/raydp/mpi/mpi_worker.py:75-96). Results return inline; large Arrow
+results go through the shm object store and return ObjectRefs.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import threading
+import time
+import traceback
+
+import cloudpickle
+
+from raydp_tpu.cluster.rpc import RpcClient, RpcServer
+from raydp_tpu.store.object_store import ObjectStore
+
+logger = logging.getLogger(__name__)
+
+WORKER_SERVICE = "raydp.Worker"
+REGISTER_RETRIES = 3
+
+
+class WorkerContext:
+    """Handed to every shipped task as its first argument."""
+
+    def __init__(self, worker_id: str, node_id: str, store: ObjectStore,
+                 master: RpcClient):
+        self.worker_id = worker_id
+        self.node_id = node_id
+        self.store = store
+        self._master = master
+
+    def put_table(self, table):
+        """Store an Arrow table owned by this worker; returns ObjectRef.
+
+        The ref is registered in the master's object directory so owner
+        lifetime is enforced cluster-wide (reference: executor-side
+        Ray.put makes the object cluster-visible, ObjectStoreWriter.scala:58-79).
+        """
+        ref = self.store.put_arrow_table(table, owner=self.worker_id)
+        self._master.call("RegisterObject", {"ref": ref})
+        return ref
+
+    def put_bytes(self, data) -> "ObjectRef":
+        ref = self.store.put(data, owner=self.worker_id)
+        self._master.call("RegisterObject", {"ref": ref})
+        return ref
+
+    def get_table(self, ref):
+        return self.store.get_arrow_table(ref)
+
+
+class Worker:
+    def __init__(self, worker_id: str, master_address: str, node_id: str,
+                 resources: dict):
+        self.worker_id = worker_id
+        self.node_id = node_id
+        self.resources = resources
+        self.master = RpcClient(master_address, "raydp.AppMaster")
+        self.store: ObjectStore = None  # namespace learned at registration
+        self.ctx: WorkerContext = None
+        self._stop_event = threading.Event()
+        self._server = RpcServer(
+            WORKER_SERVICE,
+            {
+                "RunTask": self._on_run_task,
+                "Ping": lambda req: {"pong": True, "worker_id": self.worker_id},
+                "Stop": self._on_stop,
+            },
+        )
+
+    def register(self) -> None:
+        last_exc = None
+        for attempt in range(REGISTER_RETRIES):
+            try:
+                reply = self.master.call(
+                    "RegisterWorker",
+                    {
+                        "worker_id": self.worker_id,
+                        "address": self._server.address,
+                        "pid": os.getpid(),
+                        "node_id": self.node_id,
+                        "resources": self.resources,
+                    },
+                )
+                namespace = reply["namespace"]
+                self.store = ObjectStore(namespace=namespace)
+                self.ctx = WorkerContext(
+                    self.worker_id, self.node_id, self.store, self.master
+                )
+                return
+            except Exception as exc:
+                last_exc = exc
+                time.sleep(0.5 * (attempt + 1))
+        raise RuntimeError(
+            f"worker {self.worker_id} failed to register after "
+            f"{REGISTER_RETRIES} attempts: {last_exc}"
+        )
+
+    def _on_run_task(self, req: dict) -> dict:
+        fn = cloudpickle.loads(req["fn"])
+        args = req.get("args", ())
+        kwargs = req.get("kwargs", {})
+        try:
+            result = fn(self.ctx, *args, **kwargs)
+            return {"result": result}
+        except Exception:
+            # Let RpcServer._wrap serialize the failure uniformly.
+            raise
+
+    def _on_stop(self, req: dict) -> dict:
+        # Register the objects this worker still owns with the master before
+        # exit? No — ownership semantics: non-transferred objects die with
+        # the worker; the master unlinks them on WorkerStopped/death.
+        self._stop_event.set()
+        return {"stopping": True}
+
+    def run(self) -> None:
+        self.register()
+        missed = 0
+        while not self._stop_event.wait(2.0):
+            reply = self.master.try_call(
+                "Heartbeat", {"worker_id": self.worker_id}, timeout=5.0
+            )
+            if reply is None:
+                # Transient master hiccups are absorbed (the master-side
+                # timeout is 10s); only a sustained outage means exit.
+                missed += 1
+                if missed >= 3:
+                    logger.warning(
+                        "worker %s: master unreachable for %d beats; exiting",
+                        self.worker_id, missed,
+                    )
+                    break
+                continue
+            missed = 0
+            if not reply.get("known", False):
+                # Master explicitly wrote us off — exit now (parity with
+                # executor exit on AppMaster disconnect).
+                logger.warning("worker %s: master disowned us; exiting",
+                               self.worker_id)
+                break
+        self.master.try_call(
+            "WorkerStopped", {"worker_id": self.worker_id}, timeout=2.0
+        )
+        self._server.stop()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--worker-id", required=True)
+    parser.add_argument("--master", required=True)
+    parser.add_argument("--node-id", default="node-0")
+    parser.add_argument("--cores", type=float, default=1.0)
+    parser.add_argument("--memory", type=float, default=0.0)
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format=f"[{args.worker_id}] %(levelname)s %(message)s",
+    )
+    worker = Worker(
+        args.worker_id,
+        args.master,
+        args.node_id,
+        {"cpu": args.cores, "memory": args.memory},
+    )
+    try:
+        worker.run()
+    except Exception:
+        traceback.print_exc()
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -113,6 +113,16 @@ class ObjectStore:
     def contains(self, ref_or_id) -> bool:
         return shm.exists(self._segment_name(self._object_id(ref_or_id)))
 
+    # -- directory ------------------------------------------------------
+    def register_ref(self, ref: ObjectRef) -> None:
+        """Adopt an externally created object (e.g. written by a worker
+        process) into this directory under its declared owner."""
+        self._set_owner(ref, ref.owner)
+
+    def get_ref(self, object_id: str) -> Optional[ObjectRef]:
+        with self._lock:
+            return self._objects.get(object_id)
+
     # -- lifecycle ------------------------------------------------------
     def transfer_to_holder(self, ref: ObjectRef) -> ObjectRef:
         """Re-own the object so it survives its creating worker."""

@@ -1,0 +1,60 @@
+"""Placement-group strategy tests (capability parity with reference
+python/raydp/tests/test_spark_cluster.py:101-138)."""
+import pytest
+
+from raydp_tpu.cluster.placement import NodeInfo, PlacementError, place
+
+
+def _nodes(n, cpu=4.0, mem=8e9):
+    return [
+        NodeInfo(f"node-{i}", "127.0.0.1", {"cpu": cpu, "memory": mem})
+        for i in range(n)
+    ]
+
+
+def test_strict_pack_one_node():
+    pg = place([{"cpu": 1}] * 4, "STRICT_PACK", _nodes(3))
+    assert len(set(pg.bundle_node_ids)) == 1
+
+
+def test_strict_pack_fails_when_too_big():
+    with pytest.raises(PlacementError):
+        place([{"cpu": 3}] * 2, "STRICT_PACK", _nodes(2, cpu=4))
+
+
+def test_pack_spills_when_needed():
+    pg = place([{"cpu": 3}] * 2, "PACK", _nodes(2, cpu=4))
+    assert len(set(pg.bundle_node_ids)) == 2  # spilled but placed
+
+
+def test_strict_spread_distinct_nodes():
+    pg = place([{"cpu": 1}] * 3, "STRICT_SPREAD", _nodes(3))
+    assert len(set(pg.bundle_node_ids)) == 3
+
+
+def test_strict_spread_fails_short_nodes():
+    with pytest.raises(PlacementError):
+        place([{"cpu": 1}] * 4, "STRICT_SPREAD", _nodes(3))
+
+
+def test_spread_reuses_when_short():
+    pg = place([{"cpu": 1}] * 4, "SPREAD", _nodes(2))
+    assert len(set(pg.bundle_node_ids)) == 2
+
+
+def test_unknown_strategy():
+    with pytest.raises(PlacementError):
+        place([{"cpu": 1}], "DIAGONAL", _nodes(1))
+
+
+def test_resource_exhaustion():
+    with pytest.raises(PlacementError):
+        place([{"cpu": 9}], "PACK", _nodes(2, cpu=4))
+
+
+def test_spread_overflow_balances():
+    # 4 bundles on 2 nodes: overflow must balance 2+2, not skew 3+1.
+    pg = place([{"cpu": 1}] * 4, "SPREAD", _nodes(2, cpu=4))
+    from collections import Counter
+    counts = Counter(pg.bundle_node_ids)
+    assert sorted(counts.values()) == [2, 2]
