@@ -141,13 +141,30 @@ class Cluster:
             self._procs[worker_id] = proc
         return worker_id
 
-    def shutdown(self, del_obj_holder: bool = True) -> None:
+    def shutdown(self, del_obj_holder: bool = True, fast: bool = False) -> None:
         """Stop workers; tear down master now (del_obj_holder=True) or keep
-        it + holder objects alive for later release_holder()."""
+        it + holder objects alive for later release_holder().
+
+        ``fast=True`` (interpreter-exit path) skips the graceful RPC dance:
+        thread pools are already being torn down by CPython at that point,
+        so RPCs to/from the master would race executor shutdown.
+        """
         with self._lock:
             worker_ids = list(self._procs)
-        for worker_id in worker_ids:
-            self._stop_worker(worker_id, kill_objects=False)
+        if fast:
+            with self._lock:
+                procs = list(self._procs.values())
+                self._procs.clear()
+            for proc in procs:
+                proc.terminate()
+            for proc in procs:
+                try:
+                    proc.wait(timeout=3)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+        else:
+            for worker_id in worker_ids:
+                self._stop_worker(worker_id, kill_objects=False)
         self._pool.shutdown(wait=False)
         if self.master is not None:
             if del_obj_holder:

@@ -97,6 +97,9 @@ class Worker:
                 )
                 namespace = reply["namespace"]
                 self.store = ObjectStore(namespace=namespace)
+                from raydp_tpu.store.object_store import set_current_store
+
+                set_current_store(self.store)
                 self.ctx = WorkerContext(
                     self.worker_id, self.node_id, self.store, self.master
                 )

@@ -17,6 +17,11 @@ from raydp_tpu.config import ClusterConfig
 
 _lock = threading.RLock()
 _session: Optional["Session"] = None
+# Sessions whose workers are stopped but whose holder still owns objects
+# (stop(del_obj_holder=False) followed by a new init()). Kept reachable so
+# atexit can release their holders — orphaning them would leak /dev/shm
+# segments past process exit.
+_lingering: list = []
 
 
 class Session:
@@ -36,12 +41,12 @@ class Session:
         """Workers down — the session no longer blocks a new init()."""
         return self._workers_stopped
 
-    def stop(self, del_obj_holder: bool = True) -> None:
+    def stop(self, del_obj_holder: bool = True, fast: bool = False) -> None:
         """Idempotent, two-phase: workers stop once; the object holder can
         be released later by a second ``stop(del_obj_holder=True)`` after a
         ``stop(del_obj_holder=False)`` (else holder segments would leak)."""
         if not self._workers_stopped:
-            self.cluster.shutdown(del_obj_holder=del_obj_holder)
+            self.cluster.shutdown(del_obj_holder=del_obj_holder, fast=fast)
             self._workers_stopped = True
             self._holder_released = del_obj_holder
         elif del_obj_holder and not self._holder_released:
@@ -72,6 +77,8 @@ def init(
                 "a raydp_tpu session is already running; call "
                 "raydp_tpu.stop() first"
             )
+        if _session is not None and not _session._holder_released:
+            _lingering.append(_session)
         cfg = ClusterConfig.from_args(
             app_name=app_name,
             num_workers=num_workers,
@@ -114,7 +121,13 @@ def require_session() -> Session:
 
 @atexit.register
 def _atexit_stop() -> None:
-    try:
-        stop()
-    except Exception:
-        pass
+    # Fast path: CPython has already shut worker thread pools down before
+    # atexit runs, so graceful stop RPCs would race executor teardown.
+    with _lock:
+        doomed = ([_session] if _session is not None else []) + _lingering
+    for session in doomed:
+        try:
+            session.stop(del_obj_holder=True, fast=True)
+        except Exception:
+            pass
+    _lingering.clear()

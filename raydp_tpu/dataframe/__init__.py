@@ -1,0 +1,58 @@
+"""Partitioned Arrow DataFrame engine (the framework's Spark replacement).
+
+Public surface mirrors the PySpark idioms the reference's pipelines use
+(reference: examples/data_process.py, tensorflow_titanic.ipynb):
+
+    from raydp_tpu import dataframe as rdf
+    from raydp_tpu.dataframe import col, lit, udf, hour, dayofweek
+
+    df = rdf.read_csv("taxi.csv")
+    df = df.filter(col("fare_amount") > 0).withColumn("h", hour(col("ts")))
+    train, test = df.random_split([0.9, 0.1], seed=42)
+"""
+from raydp_tpu.dataframe.dataframe import DataFrame, GroupedData
+from raydp_tpu.dataframe.expr import (
+    CaseWhen,
+    Col,
+    Expr,
+    Lit,
+    ceil,
+    col,
+    dayofmonth,
+    dayofweek,
+    exp,
+    floor,
+    hour,
+    length,
+    lit,
+    log,
+    lower,
+    minute,
+    month,
+    quarter,
+    second,
+    sqrt,
+    udf,
+    upper,
+    weekofyear,
+    when,
+    year,
+)
+from raydp_tpu.dataframe.io import (
+    from_arrow,
+    from_items,
+    from_pandas,
+    range,
+    read_csv,
+    read_parquet,
+)
+
+__all__ = [
+    "DataFrame", "GroupedData", "Expr", "Col", "Lit", "CaseWhen",
+    "col", "lit", "udf", "when",
+    "year", "month", "dayofmonth", "hour", "minute", "second",
+    "quarter", "weekofyear", "dayofweek",
+    "sqrt", "exp", "log", "floor", "ceil", "lower", "upper", "length",
+    "from_arrow", "from_items", "from_pandas", "range",
+    "read_csv", "read_parquet",
+]

@@ -1,0 +1,634 @@
+"""Partitioned DataFrame with lazy narrow-op fusion and eager shuffles.
+
+The framework's replacement for the reference's embedded Spark: a bounded
+but complete op surface for the five baseline ETL pipelines (reference:
+examples/data_process.py filter/withColumn/UDF/drop;
+tensorflow_titanic.ipynb fillna/select; pytorch_dlrm.ipynb
+groupBy/count/join). Narrow ops (select/filter/withColumn/...) append
+fused closures to a pending pipeline — one pass over each Arrow partition
+when forced. Wide ops (groupBy/join/orderBy/repartition) flush the
+pipeline and run a hash/range exchange on the executor.
+"""
+from __future__ import annotations
+
+import secrets
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from raydp_tpu.dataframe import expr as E
+from raydp_tpu.dataframe.executor import Executor, LocalExecutor, _concat
+
+ColumnLike = Union[str, E.Expr]
+
+
+def _default_executor() -> Executor:
+    from raydp_tpu.context import current_session
+
+    session = current_session()
+    if session is not None and session.cluster.alive_workers():
+        from raydp_tpu.dataframe.executor import ClusterExecutor
+
+        return ClusterExecutor(session.cluster)
+    return LocalExecutor()
+
+
+class DataFrame:
+    def __init__(
+        self,
+        parts: List[Any],
+        executor: Optional[Executor] = None,
+        pending: Optional[List[Callable[[pa.Table], pa.Table]]] = None,
+    ):
+        self._parts = parts
+        self._executor = executor or _default_executor()
+        self._pending = list(pending or [])
+
+    # -- plan helpers ---------------------------------------------------
+    def _with(self, fn: Callable[[pa.Table], pa.Table]) -> "DataFrame":
+        return DataFrame(self._parts, self._executor, self._pending + [fn])
+
+    def _flush(self) -> "DataFrame":
+        """Run the pending narrow pipeline; afterwards partitions are
+        materialized results."""
+        if not self._pending:
+            return self
+        pipeline = list(self._pending)
+
+        def run(table: pa.Table) -> pa.Table:
+            for fn in pipeline:
+                table = fn(table)
+            return table
+
+        parts = self._executor.map_partitions(self._parts, run)
+        return DataFrame(parts, self._executor)
+
+    # -- narrow ops -----------------------------------------------------
+    def select(self, *columns: ColumnLike) -> "DataFrame":
+        exprs = [_as_expr(c) for c in columns]
+        names = [_col_name(c) for c in columns]
+        dupes = {n for n in names if names.count(n) > 1}
+        if dupes:
+            raise ValueError(
+                f"duplicate output column names in select: {sorted(dupes)}; "
+                "use .alias() to disambiguate"
+            )
+
+        def fn(t: pa.Table) -> pa.Table:
+            arrays = [_as_array(e.evaluate(t), t.num_rows) for e in exprs]
+            return pa.table(dict(zip(names, arrays)))
+
+        return self._with(fn)
+
+    def withColumn(self, name: str, column: E.Expr) -> "DataFrame":
+        e = _as_expr(column)
+
+        def fn(t: pa.Table) -> pa.Table:
+            arr = _as_array(e.evaluate(t), t.num_rows)
+            if name in t.column_names:
+                idx = t.column_names.index(name)
+                return t.set_column(idx, name, arr)
+            return t.append_column(name, arr)
+
+        return self._with(fn)
+
+    with_column = withColumn
+
+    def withColumnRenamed(self, old: str, new: str) -> "DataFrame":
+        def fn(t: pa.Table) -> pa.Table:
+            return t.rename_columns(
+                [new if c == old else c for c in t.column_names]
+            )
+
+        return self._with(fn)
+
+    def filter(self, condition: E.Expr) -> "DataFrame":
+        def fn(t: pa.Table) -> pa.Table:
+            mask = condition.evaluate(t)
+            if isinstance(mask, pa.ChunkedArray):
+                mask = mask.combine_chunks()
+            return t.filter(mask)
+
+        return self._with(fn)
+
+    where = filter
+
+    def drop(self, *names: str) -> "DataFrame":
+        def fn(t: pa.Table) -> pa.Table:
+            keep = [c for c in t.column_names if c not in names]
+            return t.select(keep)
+
+        return self._with(fn)
+
+    def dropna(self, subset: Optional[List[str]] = None) -> "DataFrame":
+        def fn(t: pa.Table) -> pa.Table:
+            return t.drop_null() if subset is None else t.filter(
+                _valid_mask(t, subset)
+            )
+
+        return self._with(fn)
+
+    def fillna(self, value, subset: Optional[List[str]] = None) -> "DataFrame":
+        def fn(t: pa.Table) -> pa.Table:
+            out = t
+            cols = subset or t.column_names
+            for name in cols:
+                if name not in out.column_names:
+                    continue
+                arr = out.column(name)
+                fill = value.get(name) if isinstance(value, dict) else value
+                if fill is None:
+                    continue
+                try:
+                    filled = pc.fill_null(arr, pa.scalar(fill, type=arr.type))
+                except (pa.ArrowInvalid, pa.ArrowTypeError, pa.ArrowNotImplementedError):
+                    continue  # incompatible fill type for this column
+                out = out.set_column(
+                    out.column_names.index(name), name, filled
+                )
+            return out
+
+        return self._with(fn)
+
+    def map_batches(self, fn: Callable[[pa.Table], pa.Table]) -> "DataFrame":
+        """Arbitrary vectorized transform (Spark mapInPandas parity —
+        reference: python/raydp/spark/dataset.py:520-534)."""
+        return self._with(fn)
+
+    def mapInPandas(self, fn) -> "DataFrame":
+        def wrapped(t: pa.Table) -> pa.Table:
+            import pandas as pd
+
+            out = fn(t.to_pandas())
+            return pa.Table.from_pandas(out, preserve_index=False)
+
+        return self._with(wrapped)
+
+    def limit(self, n: int) -> "DataFrame":
+        # Narrow approximation then global trim at collect time would be
+        # wrong for counts; do it eagerly.
+        df = self._flush()
+        out_parts: List[Any] = []
+        remaining = n
+        for part in df._parts:
+            if remaining <= 0:
+                break
+            rows = df._executor.num_rows(part)
+            if 0 <= rows <= remaining:
+                out_parts.append(part)
+                remaining -= rows
+            else:
+                table = df._executor.materialize(part).slice(0, remaining)
+                out_parts.append(df._executor.put(table))
+                remaining = 0
+        return DataFrame(out_parts, df._executor)
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        a, b = self._flush(), other._flush()
+        return DataFrame(a._parts + b._parts, a._executor)
+
+    # -- wide ops -------------------------------------------------------
+    def repartition(self, n: int) -> "DataFrame":
+        if n <= 0:
+            raise ValueError("repartition count must be positive")
+        df = self._flush()
+
+        def splitter(t: pa.Table) -> List[pa.Table]:
+            if t.num_rows == 0:
+                return [t] * n
+            sizes = _split_sizes(t.num_rows, n)
+            outs, offset = [], 0
+            for size in sizes:
+                outs.append(t.slice(offset, size))
+                offset += size
+            return outs
+
+        parts = df._executor.exchange(df._parts, splitter, n)
+        return DataFrame(parts, df._executor)
+
+    coalesce = repartition
+
+    def groupBy(self, *keys: str) -> "GroupedData":
+        return GroupedData(self, list(keys))
+
+    groupby = groupBy
+
+    def join(
+        self,
+        other: "DataFrame",
+        on: Union[str, List[str]],
+        how: str = "inner",
+    ) -> "DataFrame":
+        keys = [on] if isinstance(on, str) else list(on)
+        left, right = self._flush(), other._flush()
+
+        # Broadcast hash join (right side small — the baseline pipelines
+        # join dimension tables). Under the cluster executor the broadcast
+        # rides the shm store ONCE as an ObjectRef; embedding the table in
+        # the closure would re-ship it in every per-partition task payload.
+        join_type = {
+            "inner": "inner",
+            "left": "left outer",
+            "right": "right outer",
+            "outer": "full outer",
+            "full": "full outer",
+            "left_semi": "left semi",
+            "left_anti": "left anti",
+        }.get(how)
+        if join_type is None:
+            raise ValueError(f"unsupported join type {how!r}")
+
+        from raydp_tpu.dataframe.executor import ClusterExecutor
+
+        right_table = _concat(
+            [right._executor.materialize(p) for p in right._parts]
+        )
+        if isinstance(left._executor, ClusterExecutor):
+            broadcast_ref = left._executor.store.put_arrow_table(right_table)
+
+            def fn(t: pa.Table) -> pa.Table:
+                # Resolved worker-side via the ambient store; only the tiny
+                # ObjectRef travels in the task payload.
+                from raydp_tpu.store.object_store import get_current_store
+
+                rt = get_current_store().get_arrow_table(broadcast_ref)
+                return _join_aligned(t, rt, keys, join_type)
+
+        else:
+
+            def fn(t: pa.Table) -> pa.Table:
+                return _join_aligned(t, right_table, keys, join_type)
+
+        return left._with(fn)
+
+    def orderBy(
+        self, *columns: str, ascending: Union[bool, List[bool]] = True
+    ) -> "DataFrame":
+        df = self._flush()
+        if isinstance(ascending, bool):
+            ascending = [ascending] * len(columns)
+        sort_keys = [
+            (c, "ascending" if asc else "descending")
+            for c, asc in zip(columns, ascending)
+        ]
+        n_out = len(df._parts)
+        if n_out <= 1:
+            def sort_one(t: pa.Table) -> pa.Table:
+                return t.sort_by(sort_keys)
+
+            return DataFrame(
+                df._executor.map_partitions(df._parts, sort_one), df._executor
+            )
+
+        # Range exchange on sampled quantiles of the first sort column,
+        # then local sort (sample sort). Samples come back from the
+        # workers — partitions are never materialized on the driver.
+        key0 = columns[0]
+        samples = [
+            np.asarray(s)
+            for s in df._executor.sample_column(df._parts, key0, 64)
+            if len(s)
+        ]
+        if not samples:
+            return df
+        flat = np.sort(np.concatenate(samples))
+        qs = np.linspace(0, 1, n_out + 1)[1:-1]
+        cuts = np.quantile(flat, qs) if len(flat) else []
+        descending = not ascending[0]
+
+        def splitter(t: pa.Table) -> List[pa.Table]:
+            if t.num_rows == 0:
+                return [t] * n_out
+            vals = t.column(key0).to_pandas().to_numpy()
+            bucket = np.searchsorted(cuts, vals, side="right")
+            if descending:
+                bucket = (n_out - 1) - bucket
+            outs = []
+            for i in range(n_out):
+                outs.append(t.filter(pa.array(bucket == i)))
+            return outs
+
+        def combine(t: pa.Table) -> pa.Table:
+            return t.sort_by(sort_keys)
+
+        parts = df._executor.exchange(df._parts, splitter, n_out, combine)
+        return DataFrame(parts, df._executor)
+
+    sort = orderBy
+
+    def random_split(
+        self, weights: List[float], seed: Optional[int] = None
+    ) -> List["DataFrame"]:
+        """Split rows randomly by weight (reference:
+        python/raydp/utils.py random_split via Spark randomSplit)."""
+        if not weights or any(w <= 0 for w in weights):
+            raise ValueError("weights must be positive")
+        total = float(sum(weights))
+        bounds = np.cumsum([w / total for w in weights])
+        seed = secrets.randbits(31) if seed is None else seed
+        df = self._flush()
+
+        outs = []
+        for i in range(len(weights)):
+            lo = 0.0 if i == 0 else bounds[i - 1]
+            hi = bounds[i]
+
+            def fn(t: pa.Table, lo=lo, hi=hi) -> pa.Table:
+                # Deterministic per-table draw keyed on content hash + seed
+                # so every split pass sees identical uniforms.
+                rng = np.random.default_rng(seed + _table_fingerprint(t))
+                u = rng.random(t.num_rows)
+                return t.filter(pa.array((u >= lo) & (u < hi)))
+
+            outs.append(df._with(fn))
+        return outs
+
+    # -- actions --------------------------------------------------------
+    def collect_partitions(self) -> List[pa.Table]:
+        df = self._flush()
+        return [df._executor.materialize(p) for p in df._parts]
+
+    def to_arrow(self) -> pa.Table:
+        return _concat(self.collect_partitions())
+
+    def to_pandas(self):
+        return self.to_arrow().to_pandas()
+
+    toPandas = to_pandas
+
+    def count(self) -> int:
+        df = self._flush()
+        total = 0
+        for part in df._parts:
+            rows = df._executor.num_rows(part)
+            if rows < 0:
+                rows = df._executor.materialize(part).num_rows
+            total += rows
+        return total
+
+    def show(self, n: int = 20) -> None:
+        print(self.limit(n).to_pandas().to_string())
+
+    @property
+    def columns(self) -> List[str]:
+        return list(self.schema.names)
+
+    @property
+    def schema(self) -> pa.Schema:
+        head = self._peek()
+        return head.schema
+
+    def _peek(self) -> pa.Table:
+        """First partition with pending ops applied (schema probe)."""
+        if not self._parts:
+            return pa.table({})
+        table = self._executor.materialize(self._parts[0])
+        probe = table.slice(0, min(32, table.num_rows))
+        for fn in self._pending:
+            probe = fn(probe)
+        return probe
+
+    @property
+    def num_partitions(self) -> int:
+        return len(self._parts)
+
+    def persist(self) -> "DataFrame":
+        return self._flush()
+
+    cache = persist
+
+    def write_parquet(self, path: str) -> None:
+        import os
+
+        import pyarrow.parquet as pq
+
+        os.makedirs(path, exist_ok=True)
+        for i, table in enumerate(self.collect_partitions()):
+            pq.write_table(table, f"{path}/part-{i:05d}.parquet")
+
+    # -- shard handoff (M5 consumes this) --------------------------------
+    def to_object_refs(self, owner_transfer: bool = True) -> List[Any]:
+        """Materialize partitions into the session object store and return
+        refs (the reference's _save_spark_df_to_object_store,
+        dataset.py:198-219)."""
+        df = self._flush()
+        from raydp_tpu.dataframe.executor import ClusterExecutor
+
+        if isinstance(df._executor, ClusterExecutor):
+            refs = list(df._parts)
+            if owner_transfer:
+                store = df._executor.store
+                refs = [store.transfer_to_holder(r) for r in refs]
+            return refs
+        from raydp_tpu.context import current_session
+
+        session = current_session()
+        if session is None:
+            raise RuntimeError(
+                "to_object_refs without a live session requires cluster "
+                "execution; call raydp_tpu.init() first"
+            )
+        store = session.cluster.master.store
+        return [store.put_arrow_table(t) for t in df.collect_partitions()]
+
+
+class GroupedData:
+    """``df.groupBy(keys).agg(...)`` with distributed partial aggregation."""
+
+    _MERGEABLE = {
+        "count": "sum",
+        "sum": "sum",
+        "min": "min",
+        "max": "max",
+    }
+
+    def __init__(self, df: DataFrame, keys: List[str]):
+        if not keys:
+            raise ValueError("groupBy needs at least one key")
+        self.df = df
+        self.keys = keys
+
+    def count(self) -> DataFrame:
+        return self.agg(("*", "count"))
+
+    def agg(self, *aggs: Union[Tuple[str, str], Dict[str, str]]) -> DataFrame:
+        specs: List[Tuple[str, str]] = []
+        for a in aggs:
+            if isinstance(a, dict):
+                specs.extend(a.items())
+            else:
+                specs.append(a)
+        if not specs:
+            raise ValueError("agg needs at least one aggregation")
+
+        keys = self.keys
+        # Decompose mean into sum+count for distributed merge.
+        partial_specs: List[Tuple[str, str]] = []
+        for col_name, op in specs:
+            if op == "mean" or op == "avg":
+                partial_specs.append((col_name, "sum"))
+                partial_specs.append((col_name, "count"))
+            elif op == "count":
+                partial_specs.append((col_name, "count"))
+            elif op in self._MERGEABLE:
+                partial_specs.append((col_name, op))
+            else:
+                raise ValueError(f"unsupported aggregation {op!r}")
+        partial_specs = list(dict.fromkeys(partial_specs))
+
+        df = self.df._flush()
+        n_out = max(1, min(len(df._parts), 8))
+        # Bind plain locals for the shipped closures — referencing ``self``
+        # would drag the executor (locks, sockets) into cloudpickle.
+        mergeable = dict(self._MERGEABLE)
+
+        def splitter(t: pa.Table) -> List[pa.Table]:
+            t = _local_agg(t, keys, partial_specs)
+            if t.num_rows == 0:
+                return [t] * n_out
+            bucket = _hash_bucket(t, keys, n_out)
+            return [t.filter(pa.array(bucket == i)) for i in range(n_out)]
+
+        def combine(t: pa.Table) -> pa.Table:
+            if t.num_rows == 0:
+                return t
+            merge_specs = [
+                (_partial_name(c, op), mergeable[op])
+                for c, op in partial_specs
+            ]
+            merged = t.group_by(keys).aggregate(merge_specs)
+            # merged columns: keys + "<partial>_<mergeop>"
+            rename = {}
+            for c, op in partial_specs:
+                merged_name = f"{_partial_name(c, op)}_{mergeable[op]}"
+                rename[merged_name] = _partial_name(c, op)
+            merged = merged.rename_columns(
+                [rename.get(c, c) for c in merged.column_names]
+            )
+            return _finalize_agg(merged, keys, specs)
+
+        parts = df._executor.exchange(df._parts, splitter, n_out, combine)
+        return DataFrame(parts, df._executor)
+
+
+# -- helpers ---------------------------------------------------------------
+def _join_aligned(
+    t: pa.Table, rt: pa.Table, keys: List[str], join_type: str
+) -> pa.Table:
+    # Align key dtypes (e.g. string vs large_string from different
+    # construction paths) — arrow joins require exact type match.
+    for k in keys:
+        lt_type = t.schema.field(k).type
+        rt_type = rt.schema.field(k).type
+        if lt_type != rt_type:
+            rt = rt.set_column(
+                rt.column_names.index(k), k, pc.cast(rt.column(k), lt_type)
+            )
+    return t.join(rt, keys=keys, join_type=join_type)
+
+
+def _as_expr(c: ColumnLike) -> E.Expr:
+    return E.Col(c) if isinstance(c, str) else c
+
+
+def _col_name(c: ColumnLike) -> str:
+    return c if isinstance(c, str) else c.name
+
+
+def _as_array(value, num_rows: int):
+    if isinstance(value, pa.Scalar):
+        return pa.nulls(num_rows, value.type) if value.as_py() is None else (
+            pa.array([value.as_py()] * num_rows, type=value.type)
+        )
+    return value
+
+
+def _valid_mask(t: pa.Table, subset: List[str]):
+    mask = None
+    for name in subset:
+        valid = pc.is_valid(t.column(name))
+        mask = valid if mask is None else pc.and_(mask, valid)
+    return mask
+
+
+def _split_sizes(total: int, parts: int) -> List[int]:
+    base, extra = divmod(total, parts)
+    return [base + (1 if i < extra else 0) for i in range(parts)]
+
+
+def _table_fingerprint(t: pa.Table) -> int:
+    """Cheap content fingerprint, deterministic ACROSS PROCESSES (no
+    Python str hash — it's salted per process; random_split's complementary
+    filters may execute on different workers and must draw identical
+    uniforms)."""
+    import zlib
+
+    h = t.num_rows
+    if t.num_rows and t.num_columns:
+        first = str(t.column(0)[0].as_py())
+        last = str(t.column(0)[t.num_rows - 1].as_py())
+        h = zlib.crc32(f"{h}|{first}|{last}".encode()) & 0x7FFFFFFF
+    return h
+
+
+def _hash_bucket(t: pa.Table, keys: List[str], n: int) -> np.ndarray:
+    import pandas as pd
+
+    df = t.select(keys).to_pandas()
+    codes = pd.util.hash_pandas_object(df, index=False).to_numpy()
+    return (codes % n).astype(np.int64)
+
+
+def _partial_name(col_name: str, op: str) -> str:
+    return f"__{op}__{col_name}"
+
+
+_ROWS_COL = "__rows__"
+
+
+def _local_agg(
+    t: pa.Table, keys: List[str], specs: List[Tuple[str, str]]
+) -> pa.Table:
+    arrow_aggs = []
+    needs_rows = any(c == "*" for c, _ in specs)
+    if needs_rows:
+        # count(*) counts ROWS (null keys included) — counting a key column
+        # would skip nulls (Spark semantics: groupBy().count() = row count).
+        t = t.append_column(
+            _ROWS_COL, pa.array(np.ones(t.num_rows, dtype=np.int64))
+        )
+    for col_name, op in specs:
+        if col_name == "*":
+            arrow_aggs.append((_ROWS_COL, "sum"))
+        else:
+            arrow_aggs.append((col_name, op))
+    out = t.group_by(keys).aggregate(arrow_aggs)
+    names = []
+    for c, op in specs:
+        names.append(f"{_ROWS_COL}_sum" if c == "*" else f"{c}_{op}")
+    rename = dict(zip(names, [_partial_name(c, op) for c, op in specs]))
+    return out.rename_columns([rename.get(c, c) for c in out.column_names])
+
+
+def _finalize_agg(
+    merged: pa.Table, keys: List[str], specs: List[Tuple[str, str]]
+) -> pa.Table:
+    arrays = {k: merged.column(k) for k in keys}
+    for col_name, op in specs:
+        if op in ("mean", "avg"):
+            s = merged.column(_partial_name(col_name, "sum"))
+            c = merged.column(_partial_name(col_name, "count"))
+            arrays[f"{op}({col_name})"] = pc.divide(
+                pc.cast(s, pa.float64()), pc.cast(c, pa.float64())
+            )
+        elif op == "count":
+            arrays["count" if col_name == "*" else f"count({col_name})"] = (
+                merged.column(_partial_name(col_name, "count"))
+            )
+        else:
+            arrays[f"{op}({col_name})"] = merged.column(
+                _partial_name(col_name, op)
+            )
+    return pa.table(arrays)

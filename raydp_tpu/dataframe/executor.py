@@ -1,0 +1,192 @@
+"""Partition executors: where DataFrame stages actually run.
+
+Two backends with one interface:
+
+  * ``LocalExecutor`` — partitions are in-memory ``pa.Table``s, stages run
+    on a thread pool (pyarrow kernels release the GIL). Like Spark
+    ``local[n]``; the default when no session is live.
+  * ``ClusterExecutor`` — partitions are ``ObjectRef``s in the shm store,
+    stages ship to ETL worker processes via the control plane (the
+    reference's executor-side ``mapPartitions`` over Ray actors,
+    ObjectStoreWriter.scala:93-164). Locality: a partition is routed to a
+    stable worker per index so repeated stages reuse page-cache-warm
+    segments (reference threads locality through getPreferredLocations,
+    RayDatasetRDD.scala:53-55).
+"""
+from __future__ import annotations
+
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, List, Optional, Sequence
+
+import pyarrow as pa
+
+from raydp_tpu.store.object_store import ObjectRef, ObjectStore
+
+StageFn = Callable[[pa.Table], pa.Table]
+
+
+class Executor:
+    def map_partitions(self, parts: List[Any], fn: StageFn) -> List[Any]:
+        raise NotImplementedError
+
+    def exchange(
+        self,
+        parts: List[Any],
+        splitter: Callable[[pa.Table], List[pa.Table]],
+        n_out: int,
+        combine: Optional[StageFn] = None,
+    ) -> List[Any]:
+        """All-to-all: split every partition into n_out chunks, then
+        concatenate chunk i across partitions into output partition i."""
+        raise NotImplementedError
+
+    def materialize(self, part: Any) -> pa.Table:
+        raise NotImplementedError
+
+    def put(self, table: pa.Table) -> Any:
+        raise NotImplementedError
+
+    def num_rows(self, part: Any) -> int:
+        raise NotImplementedError
+
+    def sample_column(self, parts: List[Any], column: str, k: int) -> list:
+        """Up to ``k`` non-null sample values of ``column`` per partition,
+        WITHOUT materializing partitions on the driver (range-sort pivots)."""
+        raise NotImplementedError
+
+
+def _concat(tables: List[pa.Table]) -> pa.Table:
+    tables = [t for t in tables if t is not None]
+    if not tables:
+        return pa.table({})
+    # Drop empty tables: stages like distributed agg can emit empties with
+    # an intermediate schema (partial-agg columns); schema-promoting concat
+    # would leak those as all-null columns.
+    non_empty = [t for t in tables if t.num_rows > 0]
+    if not non_empty:
+        return tables[0]
+    if len(non_empty) == 1:
+        return non_empty[0]
+    return pa.concat_tables(non_empty, promote_options="default")
+
+
+class LocalExecutor(Executor):
+    def __init__(self, max_threads: Optional[int] = None):
+        self._pool = ThreadPoolExecutor(
+            max_workers=max_threads or min(8, (os.cpu_count() or 2) * 2)
+        )
+
+    def map_partitions(self, parts, fn):
+        return list(self._pool.map(fn, parts))
+
+    def exchange(self, parts, splitter, n_out, combine=None):
+        chunked = list(self._pool.map(splitter, parts))
+        outs = []
+        for i in range(n_out):
+            merged = _concat([chunks[i] for chunks in chunked])
+            outs.append(combine(merged) if combine else merged)
+        return outs
+
+    def materialize(self, part):
+        return part
+
+    def put(self, table):
+        return table
+
+    def num_rows(self, part):
+        return part.num_rows
+
+    def sample_column(self, parts, column, k):
+        return [
+            vals for t in parts for vals in [_sample_table(t, column, k)]
+        ]
+
+
+def _sample_table(t: pa.Table, column: str, k: int) -> list:
+    if t.num_rows == 0:
+        return []
+    series = t.column(column).to_pandas().dropna()
+    if not len(series):
+        return []
+    return series.sample(min(k, len(series)), random_state=0).tolist()
+
+
+class ClusterExecutor(Executor):
+    """Runs stages on the session's ETL workers; partitions live in shm."""
+
+    def __init__(self, cluster):
+        self.cluster = cluster
+        self.store: ObjectStore = cluster.master.store
+
+    # Stable partition→worker routing for locality.
+    def _worker_for(self, index: int) -> Optional[str]:
+        workers = self.cluster.alive_workers()
+        if not workers:
+            return None
+        ordered = sorted(w.worker_id for w in workers)
+        return ordered[index % len(ordered)]
+
+    def map_partitions(self, parts, fn):
+        def task(ctx, ref):
+            table = ctx.get_table(ref)
+            return ctx.put_table(fn(table))
+
+        futures = [
+            self.cluster.submit_async(task, ref, worker_id=self._worker_for(i))
+            for i, ref in enumerate(parts)
+        ]
+        return [f.result() for f in futures]
+
+    def exchange(self, parts, splitter, n_out, combine=None):
+        def split_task(ctx, ref):
+            table = ctx.get_table(ref)
+            return [ctx.put_table(chunk) for chunk in splitter(table)]
+
+        futures = [
+            self.cluster.submit_async(split_task, ref,
+                                      worker_id=self._worker_for(i))
+            for i, ref in enumerate(parts)
+        ]
+        chunk_refs = [f.result() for f in futures]  # [n_in][n_out]
+
+        def merge_task(ctx, refs):
+            tables = [ctx.get_table(r) for r in refs]
+            merged = _concat(tables)
+            if combine is not None:
+                merged = combine(merged)
+            return ctx.put_table(merged)
+
+        merge_futures = [
+            self.cluster.submit_async(
+                merge_task,
+                [chunks[i] for chunks in chunk_refs],
+                worker_id=self._worker_for(i),
+            )
+            for i in range(n_out)
+        ]
+        outs = [f.result() for f in merge_futures]
+        # Intermediate chunks are dead weight now.
+        for chunks in chunk_refs:
+            for ref in chunks:
+                self.store.delete(ref)
+        return outs
+
+    def materialize(self, part):
+        return self.store.get_arrow_table(part)
+
+    def put(self, table):
+        return self.store.put_arrow_table(table)
+
+    def num_rows(self, part):
+        return part.num_rows if isinstance(part, ObjectRef) else -1
+
+    def sample_column(self, parts, column, k):
+        def task(ctx, ref):
+            return _sample_table(ctx.get_table(ref), column, k)
+
+        futures = [
+            self.cluster.submit_async(task, ref, worker_id=self._worker_for(i))
+            for i, ref in enumerate(parts)
+        ]
+        return [f.result() for f in futures]

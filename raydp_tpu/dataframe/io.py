@@ -1,0 +1,116 @@
+"""DataFrame construction: files, pandas, arrow, ranges."""
+from __future__ import annotations
+
+import glob as _glob
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.csv as pa_csv
+import pyarrow.parquet as pq
+
+import builtins
+
+from raydp_tpu.dataframe.dataframe import DataFrame, _split_sizes
+from raydp_tpu.dataframe.executor import Executor, LocalExecutor
+
+
+def _executor() -> "Executor":
+    from raydp_tpu.dataframe.dataframe import _default_executor
+
+    return _default_executor()
+
+
+def _distribute(tables: List[pa.Table], executor: Optional[Executor] = None) -> DataFrame:
+    ex = executor or _executor()
+    return DataFrame([ex.put(t) for t in tables], ex)
+
+
+def from_arrow(table: pa.Table, num_partitions: int = 1) -> DataFrame:
+    if num_partitions <= 1:
+        return _distribute([table])
+    sizes = _split_sizes(table.num_rows, num_partitions)
+    parts, offset = [], 0
+    for size in sizes:
+        parts.append(table.slice(offset, size))
+        offset += size
+    return _distribute(parts)
+
+
+def from_pandas(df, num_partitions: int = 1) -> DataFrame:
+    return from_arrow(
+        pa.Table.from_pandas(df, preserve_index=False), num_partitions
+    )
+
+
+def from_items(rows: List[Dict[str, Any]], num_partitions: int = 1) -> DataFrame:
+    return from_arrow(pa.Table.from_pylist(rows), num_partitions)
+
+
+def range(n: int, num_partitions: int = 1) -> DataFrame:  # noqa: A001
+    return from_arrow(pa.table({"id": np.arange(n, dtype=np.int64)}),
+                      num_partitions)
+
+
+def read_csv(
+    path: str,
+    num_partitions: Optional[int] = None,
+    schema: Optional[pa.Schema] = None,
+    timestamp_columns: Optional[Sequence[str]] = None,
+) -> DataFrame:
+    """Read CSV file(s) into a partitioned DataFrame. ``path`` may be a
+    file, a glob, or a directory."""
+    files = _expand(path, (".csv",))
+    convert = None
+    if schema is not None:
+        convert = pa_csv.ConvertOptions(column_types=schema)
+    elif timestamp_columns:
+        convert = pa_csv.ConvertOptions(
+            column_types={c: pa.timestamp("us") for c in timestamp_columns}
+        )
+    tables = [pa_csv.read_csv(f, convert_options=convert) for f in files]
+    df = _distribute(tables)
+    if num_partitions is not None and num_partitions != len(tables):
+        df = df.repartition(num_partitions)
+    return df
+
+
+def read_parquet(
+    path: str,
+    num_partitions: Optional[int] = None,
+    columns: Optional[List[str]] = None,
+) -> DataFrame:
+    """Read parquet file(s); one partition per row group when splitting."""
+    files = _expand(path, (".parquet", ".pq"))
+    tables: List[pa.Table] = []
+    for f in files:
+        pf = pq.ParquetFile(f)
+        if num_partitions is not None and len(files) < num_partitions:
+            for rg in builtins.range(pf.num_row_groups):
+                tables.append(pf.read_row_group(rg, columns=columns))
+        else:
+            tables.append(pf.read(columns=columns))
+    df = _distribute(tables)
+    if num_partitions is not None and len(tables) != num_partitions:
+        df = df.repartition(num_partitions)
+    return df
+
+
+def _expand(path: str, extensions) -> List[str]:
+    if os.path.isdir(path):
+        files = sorted(
+            os.path.join(path, f)
+            for f in os.listdir(path)
+            if f.lower().endswith(extensions)
+        )
+    elif any(ch in path for ch in "*?["):
+        files = sorted(_glob.glob(path))
+    else:
+        files = [path]
+    if not files:
+        raise FileNotFoundError(f"no files match {path!r}")
+    missing = [f for f in files if not os.path.exists(f)]
+    if missing:
+        raise FileNotFoundError(f"missing: {missing}")
+    return files

@@ -33,6 +33,20 @@ from raydp_tpu.store import shm
 
 OWNER_HOLDER = "__holder__"
 
+# Process-wide "ambient" store: set by worker processes at registration so
+# shipped stage closures can resolve ObjectRefs (e.g. broadcast tables)
+# without threading a context handle through every callable.
+_current_store: "ObjectStore | None" = None
+
+
+def set_current_store(store: "ObjectStore") -> None:
+    global _current_store
+    _current_store = store
+
+
+def get_current_store() -> "ObjectStore | None":
+    return _current_store
+
 
 @dataclass(frozen=True)
 class ObjectRef:

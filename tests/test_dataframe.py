@@ -1,0 +1,324 @@
+"""DataFrame engine tests: expression ops, wide ops, IO, and the NYC-taxi
+preprocessing pipeline (op-surface parity with reference
+examples/data_process.py:9-94)."""
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+import raydp_tpu.dataframe as rdf
+from raydp_tpu.dataframe import col, lit, udf, when
+from raydp_tpu.dataframe import hour, dayofweek, dayofmonth, month, year
+
+
+@pytest.fixture()
+def people():
+    return rdf.from_pandas(
+        pd.DataFrame(
+            {
+                "name": ["ann", "bob", "cat", "dan", "eve", "fay"],
+                "age": [34, 21, 45, 21, 60, 17],
+                "city": ["nyc", "sf", "nyc", "la", "sf", "nyc"],
+                "income": [90.0, 70.0, None, 50.0, 120.0, 10.0],
+            }
+        ),
+        num_partitions=3,
+    )
+
+
+def test_select_filter_withcolumn(people):
+    out = (
+        people.filter(col("age") >= 21)
+        .withColumn("age2", col("age") * 2)
+        .select("name", "age2")
+        .to_pandas()
+    )
+    assert list(out.columns) == ["name", "age2"]
+    assert out["age2"].tolist() == [68, 42, 90, 42, 120]
+
+
+def test_filter_col_vs_col(people):
+    out = people.filter(col("age") > col("income")).to_pandas()
+    assert set(out["name"]) == {"eve" if False else "fay"}  # 17 > 10
+
+
+def test_drop_fillna_dropna(people):
+    assert "income" not in people.drop("income").columns
+    filled = people.fillna({"income": 0.0}).to_pandas()
+    assert filled["income"].isna().sum() == 0
+    dropped = people.dropna(subset=["income"])
+    assert dropped.count() == 5
+
+
+def test_when_case(people):
+    out = people.withColumn(
+        "bracket",
+        when(col("age") >= 60, "senior").when(col("age") >= 21, "adult")
+        .otherwise("minor"),
+    ).to_pandas()
+    assert out.set_index("name")["bracket"].to_dict() == {
+        "ann": "adult", "bob": "adult", "cat": "adult",
+        "dan": "adult", "eve": "senior", "fay": "minor",
+    }
+
+
+def test_udf(people):
+    @udf("int")
+    def square(x):
+        return int(x * x)
+
+    out = people.withColumn("sq", square("age")).to_pandas()
+    assert out["sq"].tolist() == [x * x for x in out["age"].tolist()]
+
+
+def test_groupby_count_sum_mean(people):
+    out = (
+        people.groupBy("city")
+        .agg(("age", "sum"), ("age", "mean"), ("*", "count"))
+        .to_pandas()
+        .set_index("city")
+        .sort_index()
+    )
+    assert out.loc["nyc", "sum(age)"] == 34 + 45 + 17
+    assert out.loc["sf", "mean(age)"] == pytest.approx((21 + 60) / 2)
+    assert out.loc["la", "count"] == 1
+
+
+def test_groupby_min_max(people):
+    out = (
+        people.groupBy("city").agg(("age", "min"), ("age", "max"))
+        .to_pandas().set_index("city")
+    )
+    assert out.loc["nyc", "min(age)"] == 17
+    assert out.loc["nyc", "max(age)"] == 45
+
+
+def test_join(people):
+    lookup = rdf.from_items(
+        [
+            {"city": "nyc", "state": "NY"},
+            {"city": "sf", "state": "CA"},
+        ]
+    )
+    inner = people.join(lookup, on="city").to_pandas()
+    assert len(inner) == 5  # la dropped
+    left = people.join(lookup, on="city", how="left").to_pandas()
+    assert len(left) == 6
+    assert left.loc[left["city"] == "la", "state"].isna().all()
+
+
+def test_orderby_multi_partition():
+    rng = np.random.default_rng(0)
+    df = rdf.from_pandas(
+        pd.DataFrame({"x": rng.permutation(1000), "y": rng.standard_normal(1000)}),
+        num_partitions=5,
+    )
+    out = df.orderBy("x").to_pandas()
+    assert out["x"].tolist() == sorted(out["x"].tolist())
+    desc = df.orderBy("x", ascending=False).to_pandas()
+    assert desc["x"].tolist() == sorted(desc["x"].tolist(), reverse=True)
+
+
+def test_repartition_union_limit(people):
+    rep = people.repartition(2)
+    assert rep.num_partitions == 2
+    assert rep.count() == 6
+    both = people.union(people)
+    assert both.count() == 12
+    assert both.limit(7).count() == 7
+
+
+def test_random_split(people):
+    big = rdf.range(5000, num_partitions=4)
+    a, b = big.random_split([0.8, 0.2], seed=7)
+    na, nb = a.count(), b.count()
+    assert na + nb == 5000
+    assert 0.75 * 5000 < na < 0.85 * 5000
+    # deterministic given same seed
+    a2, _ = big.random_split([0.8, 0.2], seed=7)
+    assert a2.count() == na
+    # splits are disjoint: ids don't overlap
+    ids_a = set(a.to_pandas()["id"])
+    ids_b = set(b.to_pandas()["id"])
+    assert not (ids_a & ids_b)
+
+
+def test_csv_parquet_roundtrip(tmp_path):
+    df = pd.DataFrame(
+        {"a": np.arange(100), "b": np.random.default_rng(1).standard_normal(100)}
+    )
+    csv_path = tmp_path / "data.csv"
+    df.to_csv(csv_path, index=False)
+    loaded = rdf.read_csv(str(csv_path), num_partitions=3)
+    assert loaded.count() == 100
+    assert loaded.num_partitions == 3
+
+    pq_dir = tmp_path / "pq"
+    loaded.write_parquet(str(pq_dir))
+    back = rdf.read_parquet(str(pq_dir))
+    assert back.count() == 100
+    assert set(back.columns) == {"a", "b"}
+
+
+def test_schema_and_peek(people):
+    s = people.withColumn("x", col("age") + 1).schema
+    assert "x" in s.names
+
+
+def test_datetime_functions():
+    df = rdf.from_pandas(
+        pd.DataFrame(
+            {
+                "ts": pd.to_datetime(
+                    ["2015-02-18 14:30:00", "2020-12-31 23:59:59"]
+                )
+            }
+        )
+    )
+    out = (
+        df.withColumn("y", year(col("ts")))
+        .withColumn("m", month(col("ts")))
+        .withColumn("d", dayofmonth(col("ts")))
+        .withColumn("h", hour(col("ts")))
+        .withColumn("dow", dayofweek(col("ts")))
+        .to_pandas()
+    )
+    assert out["y"].tolist() == [2015, 2020]
+    assert out["m"].tolist() == [2, 12]
+    assert out["d"].tolist() == [18, 31]
+    assert out["h"].tolist() == [14, 23]
+    # 2015-02-18 is a Wednesday → Spark dayofweek = 4
+    assert out["dow"].tolist()[0] == 4
+
+
+def test_string_timestamps_parse():
+    df = rdf.from_items([{"ts": "2015-02-18 14:30:00"}])
+    out = df.withColumn("h", hour(col("ts"))).to_pandas()
+    assert out["h"].tolist() == [14]
+
+
+def _fake_taxi(n=2000, seed=0):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame(
+        {
+            "key": np.arange(n).astype(str),
+            "fare_amount": rng.uniform(-5, 300, n),
+            "pickup_datetime": pd.to_datetime(
+                rng.integers(1420070400, 1483228800, n), unit="s"
+            ),
+            "pickup_longitude": rng.uniform(-77, -71, n),
+            "pickup_latitude": rng.uniform(37, 43, n),
+            "dropoff_longitude": rng.uniform(-77, -71, n),
+            "dropoff_latitude": rng.uniform(37, 43, n),
+            "passenger_count": rng.integers(0, 9, n),
+        }
+    )
+
+
+def nyc_taxi_preprocess(data):
+    """The reference pipeline, expressed in this engine
+    (reference: examples/data_process.py:9-94)."""
+    from raydp_tpu.dataframe import col, udf, lit
+
+    data = (
+        data.filter(col("pickup_longitude") <= -72)
+        .filter(col("pickup_longitude") >= -76)
+        .filter(col("dropoff_longitude") <= -72)
+        .filter(col("dropoff_longitude") >= -76)
+        .filter(col("pickup_latitude") <= 42)
+        .filter(col("pickup_latitude") >= 38)
+        .filter(col("dropoff_latitude") <= 42)
+        .filter(col("dropoff_latitude") >= 38)
+        .filter(col("passenger_count") <= 6)
+        .filter(col("passenger_count") >= 1)
+        .filter(col("fare_amount") > 0)
+        .filter(col("fare_amount") < 250)
+        .filter(col("dropoff_longitude") != col("pickup_longitude"))
+        .filter(col("dropoff_latitude") != col("pickup_latitude"))
+    )
+    data = (
+        data.withColumn("day", dayofmonth(col("pickup_datetime")))
+        .withColumn("hour_of_day", hour(col("pickup_datetime")))
+        .withColumn("day_of_week", dayofweek(col("pickup_datetime")) - 2)
+        .withColumn("month_of_year", month(col("pickup_datetime")))
+        .withColumn("year", year(col("pickup_datetime")))
+    )
+
+    @udf("int")
+    def night(h, weekday):
+        return int(16 <= h <= 20 and weekday < 5)
+
+    data = data.withColumn("night", night("hour_of_day", "day_of_week"))
+    data = (
+        data.withColumn(
+            "abs_diff_longitude",
+            abs(col("dropoff_longitude") - col("pickup_longitude")),
+        )
+        .withColumn(
+            "abs_diff_latitude",
+            abs(col("dropoff_latitude") - col("pickup_latitude")),
+        )
+        .withColumn(
+            "manhattan", col("abs_diff_latitude") + col("abs_diff_longitude")
+        )
+    )
+    return data.drop(
+        "pickup_datetime",
+        "pickup_longitude",
+        "pickup_latitude",
+        "dropoff_longitude",
+        "dropoff_latitude",
+        "passenger_count",
+        "key",
+    )
+
+
+def test_nyc_taxi_pipeline_local():
+    raw = rdf.from_pandas(_fake_taxi(), num_partitions=4)
+    out = nyc_taxi_preprocess(raw)
+    result = out.to_pandas()
+    assert len(result) > 0
+    assert "manhattan" in result.columns
+    assert "pickup_datetime" not in result.columns
+    assert (result["fare_amount"] > 0).all()
+    assert result["night"].isin([0, 1]).all()
+    # equivalence against pandas reference computation
+    pdf = _fake_taxi()
+    mask = (
+        (pdf.pickup_longitude <= -72) & (pdf.pickup_longitude >= -76)
+        & (pdf.dropoff_longitude <= -72) & (pdf.dropoff_longitude >= -76)
+        & (pdf.pickup_latitude <= 42) & (pdf.pickup_latitude >= 38)
+        & (pdf.dropoff_latitude <= 42) & (pdf.dropoff_latitude >= 38)
+        & (pdf.passenger_count <= 6) & (pdf.passenger_count >= 1)
+        & (pdf.fare_amount > 0) & (pdf.fare_amount < 250)
+        & (pdf.dropoff_longitude != pdf.pickup_longitude)
+        & (pdf.dropoff_latitude != pdf.pickup_latitude)
+    )
+    assert len(result) == int(mask.sum())
+
+
+def test_error_messages():
+    df = rdf.from_items([{"a": 1}])
+    with pytest.raises(KeyError, match="'b'"):
+        df.select(col("b")).to_pandas()
+    with pytest.raises(ValueError):
+        df.join(df, on="a", how="sideways")
+    with pytest.raises(ValueError):
+        df.random_split([])
+    with pytest.raises(FileNotFoundError):
+        rdf.read_csv("/nonexistent/*.csv")
+
+
+def test_groupby_count_null_keys():
+    t = pa.table({"k": ["a", None, None], "v": [1, 2, 3]})
+    out = rdf.from_arrow(t).groupBy("k").count().to_pandas()
+    keys = [None if pd.isna(x) else x for x in out["k"].tolist()]
+    counts = dict(zip(keys, out["count"]))
+    assert counts[None] == 2  # null group counts ROWS, Spark semantics
+    assert counts["a"] == 1
+
+
+def test_select_duplicate_names_rejected():
+    df = rdf.from_items([{"x": 1}])
+    with pytest.raises(ValueError, match="duplicate"):
+        df.select("x", (col("x") + 1).alias("x"))
