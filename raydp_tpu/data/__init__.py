@@ -1,0 +1,3 @@
+from raydp_tpu.data.ml_dataset import MLDataset
+
+__all__ = ["MLDataset"]

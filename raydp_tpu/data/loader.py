@@ -1,0 +1,199 @@
+"""Host→device batch pipeline: gather, stage, prefetch, device_put.
+
+The hot path of training ingest. Per epoch:
+
+  1. shard columns live as contiguous numpy arrays (zero-copy from Arrow
+     where dtypes allow);
+  2. a permutation is drawn (epoch-seeded — reshuffle every epoch like the
+     reference's per-epoch shard shuffle, dataset.py:355-376);
+  3. batches are assembled by the native row-gather kernel
+     (raydp_tpu/native/src/gather.cpp) into reused staging buffers;
+  4. a background thread keeps ``prefetch`` staged batches ahead;
+  5. ``jax.device_put`` overlaps: batch N+1 is transferred while the
+     caller computes on batch N (double buffering — keeps the TPU from
+     stalling on HBM infeed).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from raydp_tpu.native import lib as native
+
+
+class JaxShardLoader:
+    """Iterable over (features, labels) device arrays for one shard.
+
+    Re-iterable: each ``iter()`` is a new epoch with a fresh permutation.
+    """
+
+    def __init__(
+        self,
+        dataset,
+        rank: int,
+        feature_columns: List[str],
+        label_column: Optional[str],
+        batch_size: int,
+        shuffle: bool,
+        seed: int,
+        feature_dtype,
+        label_dtype,
+        prefetch: int,
+        device,
+        drop_last: bool,
+    ):
+        self._dataset = dataset
+        self._rank = rank
+        self.feature_columns = feature_columns
+        self.label_column = label_column
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.feature_dtype = np.dtype(feature_dtype)
+        self.label_dtype = np.dtype(label_dtype)
+        self.prefetch = max(0, prefetch)
+        self.device = device
+        self.drop_last = drop_last
+        self._epoch = 0
+        self._columns: Optional[Dict[str, np.ndarray]] = None
+
+    # -- sizing ---------------------------------------------------------
+    def __len__(self) -> int:
+        n = self._dataset.rows_per_shard
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+    @property
+    def num_features(self) -> int:
+        return len(self.feature_columns)
+
+    # -- epoch iteration ------------------------------------------------
+    def __iter__(self):
+        epoch = self._epoch
+        self._epoch += 1
+        return self._epoch_iter(epoch)
+
+    def set_epoch(self, epoch: int) -> None:
+        self._epoch = epoch
+
+    def _materialize(self) -> Dict[str, np.ndarray]:
+        if self._columns is None:
+            wanted = list(self.feature_columns)
+            if self.label_column:
+                wanted.append(self.label_column)
+            self._columns = self._dataset.shard_columns(self._rank, wanted)
+        return self._columns
+
+    def _staged_batches(self, epoch: int) -> Iterator[Tuple[np.ndarray, Optional[np.ndarray]]]:
+        cols = self._materialize()
+        feats = [cols[c] for c in self.feature_columns]
+        labels = cols[self.label_column] if self.label_column else None
+        n = len(feats[0])
+        order = np.arange(n)
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + epoch * 1009 + self._rank)
+            rng.shuffle(order)
+        n_batches = len(self)
+        # The native gather stages in float32/int32 only; any other
+        # requested dtype must NOT round-trip through float32 (precision
+        # loss for float64 / int64 ids) — use the exact numpy path instead.
+        native_dtype = self.feature_dtype in (
+            np.dtype(np.float32),
+            np.dtype(np.int32),
+        )
+        for b in range(n_batches):
+            lo = b * self.batch_size
+            hi = min(lo + self.batch_size, n)
+            if lo >= hi:
+                break
+            idx = order[lo:hi]
+            if native_dtype:
+                x = native.gather_matrix(feats, idx, out_dtype=self.feature_dtype)
+            else:
+                x = np.stack(
+                    [f[idx].astype(self.feature_dtype, copy=False) for f in feats],
+                    axis=1,
+                )
+            y = None
+            if labels is not None:
+                y = labels[idx].astype(self.label_dtype, copy=False)
+            yield x, y
+
+    def _epoch_iter(self, epoch: int):
+        import jax
+
+        source = self._staged_batches(epoch)
+        stop_event = None
+        if self.prefetch > 0:
+            source, stop_event = _background(source, self.prefetch)
+
+        device = self.device
+
+        def put(batch):
+            x, y = batch
+            if device is not None:
+                x = jax.device_put(x, device)
+                y = jax.device_put(y, device) if y is not None else None
+            return (x, y) if self.label_column else x
+
+        # Double buffer: keep one transfer in flight ahead of the consumer.
+        try:
+            pending = None
+            for batch in source:
+                staged = put(batch)
+                if pending is not None:
+                    yield pending
+                pending = staged
+            if pending is not None:
+                yield pending
+        finally:
+            # Abandoned epoch (early break / single next()): unblock the
+            # producer thread so it exits instead of leaking.
+            if stop_event is not None:
+                stop_event.set()
+
+
+def _background(it: Iterator, depth: int):
+    """Run ``it`` in a daemon thread, buffering ``depth`` items.
+
+    Returns ``(iterator, stop_event)``; setting the event makes the
+    producer drain out promptly (a full queue never blocks it forever)."""
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    _DONE = object()
+    stop = threading.Event()
+
+    def _put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def producer():
+        try:
+            for item in it:
+                if not _put(item):
+                    return
+            _put(_DONE)
+        except BaseException as exc:  # surface errors on the consumer side
+            _put(exc)
+
+    thread = threading.Thread(target=producer, daemon=True)
+    thread.start()
+
+    def consume():
+        while True:
+            item = q.get()
+            if item is _DONE:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+
+    return consume(), stop

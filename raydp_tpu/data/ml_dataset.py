@@ -1,0 +1,223 @@
+"""Sharded ML dataset: the DataFrame → trainer handoff.
+
+Capability parity with the reference's RayMLDataset layer
+(reference: python/raydp/spark/dataset.py:43-457 — RecordPiece shards,
+``from_spark``/``from_parquet``/``to_torch``, equal-sample division via
+``divide_blocks``, locality-aware shard selection). TPU-first differences:
+shards map to the **data axis of the device mesh** (one shard per dp rank),
+and consumption is a double-buffered ``jax.device_put`` infeed instead of a
+torch DataLoader (though ``to_torch`` exists for interop).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+import pyarrow as pa
+
+from raydp_tpu.store.object_store import ObjectRef, ObjectStore
+from raydp_tpu.utils.sharding import BlockSlice, divide_blocks
+
+Block = Union[pa.Table, ObjectRef]
+
+
+class MLDataset:
+    """An immutable list of Arrow blocks + a shard plan over them.
+
+    Every shard yields exactly ``ceil(total_rows / num_shards)`` samples per
+    epoch (block reuse pads short shards) so SPMD data-parallel steps stay
+    in lockstep.
+    """
+
+    def __init__(
+        self,
+        blocks: List[Block],
+        num_shards: int,
+        shuffle: bool = False,
+        shuffle_seed: Optional[int] = None,
+        store: Optional[ObjectStore] = None,
+    ):
+        if not blocks:
+            raise ValueError("MLDataset needs at least one block")
+        self.blocks = blocks
+        self.num_shards = num_shards
+        self.shuffle = shuffle
+        self.shuffle_seed = shuffle_seed
+        self._store = store
+        self._block_sizes = [self._block_rows(b) for b in blocks]
+        if len(blocks) < num_shards:
+            raise ValueError(
+                f"{len(blocks)} blocks cannot feed {num_shards} shards; "
+                "repartition the DataFrame first"
+            )
+        self.shard_plan: Dict[int, List[BlockSlice]] = divide_blocks(
+            self._block_sizes, num_shards, shuffle, shuffle_seed
+        )
+
+    # -- constructors ---------------------------------------------------
+    @staticmethod
+    def from_df(
+        df,
+        num_shards: int,
+        shuffle: bool = False,
+        shuffle_seed: Optional[int] = None,
+        owner_transfer: bool = True,
+    ) -> "MLDataset":
+        """From a raydp_tpu DataFrame (reference: RayMLDataset.from_spark,
+        dataset.py:283-310). Repartitions up to ``num_shards`` if short."""
+        if df.num_partitions < num_shards:
+            df = df.repartition(num_shards)
+        from raydp_tpu.context import current_session
+
+        session = current_session()
+        if session is not None:
+            refs = df.to_object_refs(owner_transfer=owner_transfer)
+            store = session.cluster.master.store
+            return MLDataset(refs, num_shards, shuffle, shuffle_seed, store)
+        return MLDataset(
+            df.collect_partitions(), num_shards, shuffle, shuffle_seed
+        )
+
+    @staticmethod
+    def from_parquet(
+        paths: Union[str, Sequence[str]],
+        num_shards: int,
+        shuffle: bool = False,
+        shuffle_seed: Optional[int] = None,
+        columns: Optional[List[str]] = None,
+    ) -> "MLDataset":
+        """Directly from parquet row groups (reference:
+        RayMLDataset.from_parquet, dataset.py:313-349)."""
+        import pyarrow.parquet as pq
+
+        from raydp_tpu.dataframe.io import _expand
+
+        if isinstance(paths, str):
+            files = _expand(paths, (".parquet", ".pq"))
+        else:
+            files = list(paths)
+        tables: List[pa.Table] = []
+        for f in files:
+            pf = pq.ParquetFile(f)
+            for rg in range(pf.num_row_groups):
+                tables.append(pf.read_row_group(rg, columns=columns))
+        return MLDataset(tables, num_shards, shuffle, shuffle_seed)
+
+    # -- introspection --------------------------------------------------
+    @property
+    def total_rows(self) -> int:
+        return sum(self._block_sizes)
+
+    @property
+    def rows_per_shard(self) -> int:
+        return math.ceil(self.total_rows / self.num_shards)
+
+    def schema(self) -> pa.Schema:
+        return self._resolve(self.blocks[0]).schema
+
+    # -- shard access ---------------------------------------------------
+    def shard_tables(self, rank: int) -> List[pa.Table]:
+        """The (sliced) blocks assigned to ``rank``."""
+        if rank not in self.shard_plan:
+            raise IndexError(f"rank {rank} out of {self.num_shards}")
+        out = []
+        for s in self.shard_plan[rank]:
+            table = self._resolve(self.blocks[s.block_index])
+            if s.offset == 0 and s.num_samples == table.num_rows:
+                out.append(table)
+            else:
+                out.append(table.slice(s.offset, s.num_samples))
+        return out
+
+    def shard_columns(
+        self, rank: int, columns: Optional[List[str]] = None
+    ) -> Dict[str, np.ndarray]:
+        """Shard materialized as contiguous numpy columns (loader input)."""
+        tables = self.shard_tables(rank)
+        merged = (
+            pa.concat_tables(tables, promote_options="default")
+            if len(tables) > 1
+            else tables[0]
+        )
+        names = columns or merged.column_names
+        out: Dict[str, np.ndarray] = {}
+        for name in names:
+            # Direct Arrow→numpy (zero-copy when no nulls + numeric); no
+            # pandas Series intermediary on the ingest path.
+            out[name] = merged.column(name).to_numpy(zero_copy_only=False)
+        return out
+
+    def to_jax(
+        self,
+        feature_columns: List[str],
+        label_column: Optional[str] = None,
+        batch_size: int = 256,
+        rank: int = 0,
+        shuffle: bool = True,
+        seed: int = 0,
+        feature_dtype=np.float32,
+        label_dtype=np.float32,
+        prefetch: int = 2,
+        device=None,
+        drop_last: bool = False,
+    ):
+        """Device-feeding batch iterator for this shard (the TPU-native
+        counterpart of ``to_torch``, reference dataset.py:411-443)."""
+        from raydp_tpu.data.loader import JaxShardLoader
+
+        return JaxShardLoader(
+            self,
+            rank=rank,
+            feature_columns=feature_columns,
+            label_column=label_column,
+            batch_size=batch_size,
+            shuffle=shuffle,
+            seed=seed,
+            feature_dtype=feature_dtype,
+            label_dtype=label_dtype,
+            prefetch=prefetch,
+            device=device,
+            drop_last=drop_last,
+        )
+
+    def to_torch(
+        self,
+        feature_columns: List[str],
+        label_column: str,
+        batch_size: int = 256,
+        rank: int = 0,
+        shuffle: bool = True,
+        seed: int = 0,
+    ):
+        """Torch IterableDataset over this shard (API parity with the
+        reference's TorchMLDataset, torch/torch_ml_dataset.py:25-111)."""
+        from raydp_tpu.data.torch_adapter import TorchShardDataset
+
+        return TorchShardDataset(
+            self, rank, feature_columns, label_column, batch_size, shuffle,
+            seed,
+        )
+
+    # -- internals ------------------------------------------------------
+    def _resolve(self, block: Block) -> pa.Table:
+        if isinstance(block, ObjectRef):
+            store = self._store
+            if store is None:
+                from raydp_tpu.store.object_store import get_current_store
+
+                store = get_current_store()
+            if store is None:
+                raise RuntimeError(
+                    "ObjectRef blocks need a live store to resolve"
+                )
+            return store.get_arrow_table(block)
+        return block
+
+    def _block_rows(self, block: Block) -> int:
+        if isinstance(block, ObjectRef):
+            if block.num_rows < 0:
+                return self._resolve(block).num_rows
+            return block.num_rows
+        return block.num_rows

@@ -1,0 +1,56 @@
+"""Torch interop: iterate an MLDataset shard as a torch IterableDataset.
+
+API parity with the reference's torch adapters
+(reference: python/raydp/torch/torch_ml_dataset.py:25-111 —
+TorchMLDataset/PrefetchedDataLoader). Torch here is CPU-only interop for
+users migrating pipelines; the TPU path is ``MLDataset.to_jax``.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+
+class TorchShardDataset:
+    """torch.utils.data.IterableDataset over one shard (lazy torch import
+    so the framework never requires torch)."""
+
+    def __new__(cls, dataset, rank, feature_columns, label_column,
+                batch_size, shuffle, seed):
+        import torch
+        from torch.utils.data import IterableDataset
+
+        class _Impl(IterableDataset):
+            def __init__(self):
+                self._loader = dataset.to_jax(
+                    feature_columns=feature_columns,
+                    label_column=label_column,
+                    batch_size=batch_size,
+                    rank=rank,
+                    shuffle=shuffle,
+                    seed=seed,
+                    prefetch=0,
+                    device=None,
+                )
+
+            def __iter__(self):
+                # Under DataLoader(num_workers>0) torch replicates the
+                # IterableDataset per worker; split batches round-robin so
+                # samples aren't duplicated (reference guards likewise via
+                # get_worker_info, torch_ml_dataset.py:25-60).
+                info = torch.utils.data.get_worker_info()
+                wid = info.id if info is not None else 0
+                nworkers = info.num_workers if info is not None else 1
+                for i, (x, y) in enumerate(self._loader):
+                    if i % nworkers != wid:
+                        continue
+                    yield (
+                        torch.from_numpy(np.ascontiguousarray(x)),
+                        torch.from_numpy(np.ascontiguousarray(y)),
+                    )
+
+            def __len__(self):
+                return len(self._loader)
+
+        return _Impl()

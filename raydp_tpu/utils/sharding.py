@@ -24,10 +24,11 @@ import numpy as np
 
 @dataclass(frozen=True)
 class BlockSlice:
-    """``num_samples`` rows taken from the front of block ``block_index``."""
+    """Rows ``[offset, offset + num_samples)`` of block ``block_index``."""
 
     block_index: int
     num_samples: int
+    offset: int = 0
 
 
 def divide_blocks(
@@ -36,13 +37,22 @@ def divide_blocks(
     shuffle: bool = False,
     shuffle_seed: Optional[int] = None,
 ) -> Dict[int, List[BlockSlice]]:
-    """Assign blocks to ranks with an equal sample count per rank.
+    """Assign block slices to ranks with an equal sample count per rank
+    AND full coverage.
+
+    Algorithm: (optionally shuffled) block order defines a global row
+    sequence; rank r owns the contiguous span
+    ``[r * per_rank, (r + 1) * per_rank)`` of it, with the final rank
+    wrapping around to the sequence head for padding. Unlike the
+    reference's front-only block reuse (reference:
+    python/raydp/utils.py:149-222, which can silently exclude block tails
+    from every rank), every row is covered exactly once, padding excepted.
 
     Invariants (checked by tests):
       * every rank gets exactly ``ceil(sum(blocks) / world_size)`` samples;
-      * each ``BlockSlice.num_samples <= blocks[block_index]``;
-      * with ``shuffle=False`` the assignment is deterministic; with a fixed
-        ``shuffle_seed`` it is reproducible.
+      * every (block, row) pair appears in >= 1 rank's plan;
+      * slices never exceed their block bounds;
+      * deterministic given (shuffle, shuffle_seed).
     """
     blocks = list(blocks)
     if world_size <= 0:
@@ -55,41 +65,44 @@ def divide_blocks(
     if any(b < 0 for b in blocks):
         raise ValueError("block sizes must be non-negative")
 
-    num_blocks = len(blocks)
-    blocks_per_rank = math.ceil(num_blocks / world_size)
-    samples_per_rank = math.ceil(sum(blocks) / world_size)
+    total = sum(blocks)
+    if total == 0:
+        raise ValueError("dataset has no rows")
+    samples_per_rank = math.ceil(total / world_size)
 
-    # Pad the index list by wrapping around so it divides evenly, then deal
-    # round-robin: rank r takes indexes r, r+world, r+2*world, ...
-    padded = list(range(num_blocks))
-    padded += padded[: blocks_per_rank * world_size - num_blocks]
-
-    rng = np.random.default_rng(0 if shuffle_seed is None else shuffle_seed)
+    order = list(range(len(blocks)))
     if shuffle:
-        perm = rng.permutation(len(padded))
-        padded = [padded[i] for i in perm]
+        rng = np.random.default_rng(
+            0 if shuffle_seed is None else shuffle_seed
+        )
+        rng.shuffle(order)
+
+    # Global sequence: (block_index, start_of_block_in_sequence).
+    starts = []
+    pos = 0
+    for b in order:
+        starts.append(pos)
+        pos += blocks[b]
+
+    def span_slices(lo: int, hi: int) -> List[BlockSlice]:
+        """Slices covering global rows [lo, hi)."""
+        out: List[BlockSlice] = []
+        for b, start in zip(order, starts):
+            size = blocks[b]
+            s_lo = max(lo, start)
+            s_hi = min(hi, start + size)
+            if s_lo < s_hi:
+                out.append(BlockSlice(b, s_hi - s_lo, s_lo - start))
+        return out
 
     assignment: Dict[int, List[BlockSlice]] = {}
     for rank in range(world_size):
-        own = padded[rank :: world_size]
-        taken = 0
-        plan: List[BlockSlice] = []
-
-        def take(index: int) -> None:
-            nonlocal taken
-            remaining = samples_per_rank - taken
-            n = min(blocks[index], remaining)
-            if n > 0:
-                plan.append(BlockSlice(index, n))
-                taken += n
-
-        for index in own:
-            take(index)
-            if taken == samples_per_rank:
-                break
-        # Short rank: top up with randomly chosen blocks (reuse allowed).
-        while taken < samples_per_rank:
-            take(int(rng.integers(0, num_blocks)))
+        lo = rank * samples_per_rank
+        hi = min(lo + samples_per_rank, total)
+        plan = span_slices(lo, hi)
+        short = samples_per_rank - (hi - lo)
+        if short > 0:  # final rank pads by wrapping to the sequence head
+            plan += span_slices(0, short)
         assignment[rank] = plan
     return assignment
 

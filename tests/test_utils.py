@@ -77,3 +77,36 @@ def test_split_sizes():
     assert split_sizes(10, 3) == (4, 3, 3)
     assert sum(split_sizes(17, 5)) == 17
     assert split_sizes(2, 4) == (1, 1, 0, 0)
+
+
+def test_divide_blocks_full_coverage():
+    # Regression: block tails must not be silently dropped (5 blocks of
+    # 200 over 2 ranks used to lose rows 100-199 of one block).
+    blocks = [200] * 5
+    assignment = divide_blocks(blocks, 2)
+    covered = {i: set() for i in range(len(blocks))}
+    for plan in assignment.values():
+        for s in plan:
+            covered[s.block_index].update(
+                range(s.offset, s.offset + s.num_samples)
+            )
+            assert s.offset >= 0
+            assert s.offset + s.num_samples <= blocks[s.block_index]
+    for i, size in enumerate(blocks):
+        assert covered[i] == set(range(size)), f"block {i} rows dropped"
+
+
+def test_divide_blocks_coverage_with_shuffle():
+    blocks = [13, 7, 29, 3, 17, 11]
+    assignment = divide_blocks(blocks, 4, shuffle=True, shuffle_seed=9)
+    counts = assignment_sample_counts(assignment)
+    per = math.ceil(sum(blocks) / 4)
+    assert all(c == per for c in counts.values())
+    covered = {i: set() for i in range(len(blocks))}
+    for plan in assignment.values():
+        for s in plan:
+            covered[s.block_index].update(
+                range(s.offset, s.offset + s.num_samples)
+            )
+    for i, size in enumerate(blocks):
+        assert covered[i] == set(range(size))
