@@ -1,0 +1,3 @@
+from raydp_tpu.models.mlp import MLP, binary_classifier, taxi_fare_regressor
+
+__all__ = ["MLP", "binary_classifier", "taxi_fare_regressor"]

@@ -1,0 +1,11 @@
+from raydp_tpu.train.estimator import JAXEstimator, TrainingCallback
+from raydp_tpu.train.losses import LOSSES, METRICS, resolve_loss, resolve_metric
+
+__all__ = [
+    "JAXEstimator",
+    "TrainingCallback",
+    "LOSSES",
+    "METRICS",
+    "resolve_loss",
+    "resolve_metric",
+]

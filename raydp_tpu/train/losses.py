@@ -1,0 +1,100 @@
+"""Loss and metric functions (name-addressable, like the reference's
+string-configured losses — reference: tf/estimator.py:87-132 serializes
+keras losses by name; torch estimator takes loss instances)."""
+from __future__ import annotations
+
+from typing import Callable, Dict, Union
+
+import jax.numpy as jnp
+import optax
+
+
+def mse(preds, targets):
+    preds = preds.squeeze(-1) if preds.ndim == targets.ndim + 1 else preds
+    return jnp.mean((preds - targets) ** 2)
+
+
+def mae(preds, targets):
+    preds = preds.squeeze(-1) if preds.ndim == targets.ndim + 1 else preds
+    return jnp.mean(jnp.abs(preds - targets))
+
+
+def smooth_l1(preds, targets, beta: float = 1.0):
+    """Huber/SmoothL1 (the reference's taxi example trains with
+    nn.SmoothL1Loss, examples/pytorch_nyctaxi.py)."""
+    preds = preds.squeeze(-1) if preds.ndim == targets.ndim + 1 else preds
+    diff = jnp.abs(preds - targets)
+    return jnp.mean(
+        jnp.where(diff < beta, 0.5 * diff**2 / beta, diff - 0.5 * beta)
+    )
+
+
+def binary_crossentropy(logits, targets):
+    logits = (
+        logits.squeeze(-1) if logits.ndim == targets.ndim + 1 else logits
+    )
+    return jnp.mean(
+        optax.sigmoid_binary_cross_entropy(logits, targets.astype(jnp.float32))
+    )
+
+
+def softmax_crossentropy(logits, targets):
+    return jnp.mean(
+        optax.softmax_cross_entropy_with_integer_labels(
+            logits, targets.astype(jnp.int32)
+        )
+    )
+
+
+LOSSES: Dict[str, Callable] = {
+    "mse": mse,
+    "mae": mae,
+    "smooth_l1": smooth_l1,
+    "huber": smooth_l1,
+    "bce": binary_crossentropy,
+    "binary_crossentropy": binary_crossentropy,
+    "softmax_ce": softmax_crossentropy,
+    "sparse_categorical_crossentropy": softmax_crossentropy,
+}
+
+
+def resolve_loss(loss: Union[str, Callable]) -> Callable:
+    if callable(loss):
+        return loss
+    if loss in LOSSES:
+        return LOSSES[loss]
+    raise ValueError(f"unknown loss {loss!r}; known: {sorted(LOSSES)}")
+
+
+# -- metrics ---------------------------------------------------------------
+def binary_accuracy(logits, targets):
+    logits = (
+        logits.squeeze(-1) if logits.ndim == targets.ndim + 1 else logits
+    )
+    return jnp.mean(((logits > 0).astype(jnp.int32) == targets.astype(jnp.int32)
+                     ).astype(jnp.float32))
+
+
+def categorical_accuracy(logits, targets):
+    return jnp.mean(
+        (jnp.argmax(logits, -1) == targets.astype(jnp.int32)).astype(
+            jnp.float32
+        )
+    )
+
+
+METRICS: Dict[str, Callable] = {
+    "mse": mse,
+    "mae": mae,
+    "accuracy": binary_accuracy,
+    "binary_accuracy": binary_accuracy,
+    "categorical_accuracy": categorical_accuracy,
+}
+
+
+def resolve_metric(metric: Union[str, Callable]) -> Callable:
+    if callable(metric):
+        return metric
+    if metric in METRICS:
+        return METRICS[metric]
+    raise ValueError(f"unknown metric {metric!r}; known: {sorted(METRICS)}")

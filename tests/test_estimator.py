@@ -1,0 +1,275 @@
+"""JAXEstimator tests: loss decreases on real data flows, multi-device DP
+via the mesh, checkpoint roundtrip, callbacks (test-shape parity with
+reference test_torch.py / test_tf.py but with NUMERIC assertions, which
+the reference lacks — SURVEY §4)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+import optax
+
+import raydp_tpu.dataframe as rdf
+from raydp_tpu.data import MLDataset
+from raydp_tpu.models import MLP, binary_classifier
+from raydp_tpu.parallel import MeshSpec
+from raydp_tpu.train import JAXEstimator, TrainingCallback
+
+
+def _linear_df(n=2048, noise=0.05, seed=0, parts=4):
+    """y = 2a - 3b + 1 + noise (like the reference's synthetic linear data,
+    test_torch.py:28-48)."""
+    rng = np.random.default_rng(seed)
+    a = rng.standard_normal(n)
+    b = rng.standard_normal(n)
+    y = 2 * a - 3 * b + 1 + noise * rng.standard_normal(n)
+    return rdf.from_pandas(
+        pd.DataFrame({"a": a, "b": b, "y": y}), num_partitions=parts
+    )
+
+
+def test_fit_on_df_loss_decreases():
+    est = JAXEstimator(
+        model=MLP(hidden=(32, 16), out_dim=1),
+        optimizer=optax.adam(1e-2),
+        loss="mse",
+        num_epochs=8,
+        batch_size=256,
+        feature_columns=["a", "b"],
+        label_column="y",
+        seed=1,
+    )
+    history = est.fit_on_df(_linear_df())
+    assert len(history) == 8
+    assert history[-1]["train_loss"] < history[0]["train_loss"]
+    assert history[-1]["train_loss"] < 0.1
+
+
+def test_fit_dp8_mesh(eight_cpu_devices):
+    est = JAXEstimator(
+        model=MLP(hidden=(32,), out_dim=1),
+        loss="mse",
+        num_epochs=4,
+        batch_size=512,
+        feature_columns=["a", "b"],
+        label_column="y",
+        mesh=MeshSpec(dp=8),
+        seed=2,
+    )
+    history = est.fit_on_df(_linear_df(4096))
+    assert history[-1]["train_loss"] < history[0]["train_loss"]
+    # state is sharded over the mesh (replicated)
+    assert est._mesh.shape["dp"] == 8
+
+
+def test_dp_matches_single_device():
+    """Gradient math: dp=8 sharded training must match dp=1 bit-for-bit-ish
+    (same global batches, same init)."""
+    def build(mesh):
+        return JAXEstimator(
+            model=MLP(hidden=(16,), out_dim=1),
+            loss="mse",
+            num_epochs=2,
+            batch_size=256,
+            feature_columns=["a", "b"],
+            label_column="y",
+            mesh=mesh,
+            seed=3,
+            shuffle=False,
+        )
+
+    h1 = build(MeshSpec(dp=1)).fit_on_df(_linear_df(1024, seed=5))
+    h8 = build(MeshSpec(dp=8)).fit_on_df(_linear_df(1024, seed=5))
+    assert h1[-1]["train_loss"] == pytest.approx(
+        h8[-1]["train_loss"], rel=1e-4
+    )
+
+
+def test_evaluate_and_metrics():
+    df = _linear_df(1024)
+    train, test = df.random_split([0.8, 0.2], seed=4)
+    est = JAXEstimator(
+        model=MLP(hidden=(32,), out_dim=1),
+        optimizer=optax.adam(1e-2),
+        loss="mse",
+        metrics=["mae"],
+        num_epochs=6,
+        batch_size=128,
+        feature_columns=["a", "b"],
+        label_column="y",
+    )
+    est.fit(
+        MLDataset.from_df(train, 1), MLDataset.from_df(test, 1)
+    )
+    last = est.history[-1]
+    assert "eval_loss" in last and "eval_mae" in last
+    assert last["eval_mae"] < 1.0
+
+
+def test_binary_classification_accuracy():
+    rng = np.random.default_rng(0)
+    n = 2048
+    a, b = rng.standard_normal(n), rng.standard_normal(n)
+    label = (a + b > 0).astype(np.float32)
+    df = rdf.from_pandas(pd.DataFrame({"a": a, "b": b, "label": label}))
+    est = JAXEstimator(
+        model=binary_classifier(hidden=(32, 16)),
+        optimizer=optax.adam(1e-2),
+        loss="bce",
+        metrics=["accuracy"],
+        num_epochs=5,
+        batch_size=256,
+        feature_columns=["a", "b"],
+        label_column="label",
+    )
+    est.fit_on_df(df)
+    ds = MLDataset.from_df(df, 1)
+    out = est.evaluate(ds)
+    assert out["accuracy"] > 0.9
+
+
+def test_callbacks_and_get_model():
+    seen = []
+
+    class Cb(TrainingCallback):
+        def on_epoch_end(self, epoch, metrics):
+            seen.append((epoch, metrics["train_loss"]))
+
+    est = JAXEstimator(
+        model=MLP(hidden=(8,), out_dim=1),
+        num_epochs=2,
+        batch_size=128,
+        feature_columns=["a", "b"],
+        label_column="y",
+        callbacks=[Cb()],
+    )
+    est.fit_on_df(_linear_df(512))
+    assert [e for e, _ in seen] == [0, 1]
+    model, params = est.get_model()
+    assert "params" in params
+
+
+def test_predict():
+    est = JAXEstimator(
+        model=MLP(hidden=(32,), out_dim=1),
+        optimizer=optax.adam(1e-2),
+        num_epochs=8,
+        batch_size=256,
+        feature_columns=["a", "b"],
+        label_column="y",
+    )
+    est.fit_on_df(_linear_df(2048))
+    x = np.array([[1.0, 0.0], [0.0, 1.0]], dtype=np.float32)
+    preds = est.predict(x).squeeze(-1)
+    assert preds[0] == pytest.approx(3.0, abs=0.5)   # 2*1 + 1
+    assert preds[1] == pytest.approx(-2.0, abs=0.5)  # -3*1 + 1
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    est = JAXEstimator(
+        model=MLP(hidden=(16,), out_dim=1),
+        num_epochs=2,
+        batch_size=128,
+        feature_columns=["a", "b"],
+        label_column="y",
+        seed=7,
+    )
+    est.fit_on_df(_linear_df(512))
+    x = np.array([[0.5, -0.5]], dtype=np.float32)
+    before = est.predict(x)
+    path = est.save(str(tmp_path / "ckpt"))
+
+    est2 = JAXEstimator(
+        model=MLP(hidden=(16,), out_dim=1),
+        feature_columns=["a", "b"],
+        label_column="y",
+    )
+    est2.restore(str(tmp_path / "ckpt"), sample_x=x)
+    after = est2.predict(x)
+    np.testing.assert_allclose(before, after, rtol=1e-6)
+
+
+def test_creator_fn_forms():
+    import optax
+
+    est = JAXEstimator(
+        model=lambda: MLP(hidden=(8,), out_dim=1),
+        optimizer=lambda: optax.sgd(1e-2),
+        num_epochs=1,
+        batch_size=64,
+        feature_columns=["a", "b"],
+        label_column="y",
+    )
+    est.fit_on_df(_linear_df(256))
+    assert len(est.history) == 1
+
+
+def test_errors():
+    est = JAXEstimator(model=MLP(), feature_columns=None, label_column=None)
+    with pytest.raises(ValueError, match="feature_columns"):
+        est.fit(MLDataset.from_df(_linear_df(64), 1))
+    with pytest.raises(RuntimeError, match="fit"):
+        est.get_model()
+    with pytest.raises(ValueError, match="unknown loss"):
+        JAXEstimator(model=MLP(), loss="nope")
+
+
+def test_multishard_dataset_fully_consumed():
+    # Regression: fit() must train on ALL shards, not just rank 0.
+    df = _linear_df(1024, parts=4)
+    est = JAXEstimator(
+        model=MLP(hidden=(8,), out_dim=1),
+        num_epochs=1,
+        batch_size=128,
+        feature_columns=["a", "b"],
+        label_column="y",
+        shuffle=False,
+    )
+    est.fit(MLDataset.from_df(df, num_shards=4))
+    # 4 shards x 256 rows = 1024 samples seen in the epoch
+    assert est.history[0]["samples_per_sec"] > 0
+    ds = MLDataset.from_df(df, num_shards=4)
+    total = sum(
+        sum(t.num_rows for t in ds.shard_tables(r)) for r in range(4)
+    )
+    assert total == 1024
+
+
+def test_tiny_batch_on_big_mesh(eight_cpu_devices):
+    # pad > len(x): 2 rows on a dp=8 mesh must not crash.
+    est = JAXEstimator(
+        model=MLP(hidden=(4,), out_dim=1),
+        num_epochs=1,
+        batch_size=64,
+        feature_columns=["a", "b"],
+        label_column="y",
+        mesh=MeshSpec(dp=8),
+    )
+    est.fit_on_df(_linear_df(64, parts=2))
+    preds = est.predict(np.zeros((2, 2), dtype=np.float32))
+    assert preds.shape[0] == 2
+
+
+def test_dropout_active_in_training():
+    # A dropout model must train with dropout ON (needs rngs) — this
+    # crashes with a flax error if the rng isn't passed.
+    est = JAXEstimator(
+        model=MLP(hidden=(16,), out_dim=1, dropout_rate=0.5),
+        num_epochs=2,
+        batch_size=128,
+        feature_columns=["a", "b"],
+        label_column="y",
+    )
+    est.fit_on_df(_linear_df(512))
+    assert len(est.history) == 2
+
+
+def test_num_epochs_zero():
+    est = JAXEstimator(
+        model=MLP(hidden=(4,), out_dim=1),
+        num_epochs=3,
+        batch_size=64,
+        feature_columns=["a", "b"],
+        label_column="y",
+    )
+    est.fit(MLDataset.from_df(_linear_df(64), 1), num_epochs=0)
+    assert est.history == []
