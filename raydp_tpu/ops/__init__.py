@@ -1,0 +1,13 @@
+from raydp_tpu.ops.attention import (
+    reference_attention,
+    ring_attention,
+    ulysses_attention,
+)
+from raydp_tpu.ops.flash_attention import flash_attention
+
+__all__ = [
+    "reference_attention",
+    "ring_attention",
+    "ulysses_attention",
+    "flash_attention",
+]

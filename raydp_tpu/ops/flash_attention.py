@@ -1,0 +1,173 @@
+"""Pallas TPU flash attention (single-chip / per-ring-block path).
+
+Online-softmax blockwise attention keeping scores in VMEM — the MXU does
+q@k^T and p@v per tile; HBM traffic is O(S·D) instead of O(S²). Grid is
+(batch, heads, q_blocks); the kv loop runs inside the kernel with running
+(max, sum, acc) carries.
+
+Falls back to interpret mode off-TPU (pallas guide: Debugging) so tests
+exercise identical code paths on the CPU mesh.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                  block_kv: int, causal: bool, scale: float, q_block: int):
+    """Grid (b, h, q_blocks, kv_blocks); kv is the innermost sequential
+    dimension, so only one [block_kv, d] K/V tile is VMEM-resident at a
+    time and the (m, l, acc) scratch carries across kv steps."""
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    n_kv = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # Causal: blocks strictly above the diagonal contribute nothing.
+    q_end = (qi + 1) * q_block - 1  # last query position in this block
+    k_start = ki * block_kv
+    live = (q_end >= k_start) if causal else True
+
+    @pl.when(live)
+    def _attend():
+        q = q_ref[0, 0].astype(jnp.float32) * scale  # [q_block, d]
+        k = k_ref[0, 0].astype(jnp.float32)          # [block_kv, d]
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = q @ k.T
+        if causal:
+            q_pos = qi * q_block + jax.lax.broadcasted_iota(
+                jnp.int32, (q_block, block_kv), 0
+            )
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (q_block, block_kv), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_prev = m_ref[...]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        m_ref[...] = m_new
+        l_ref[...] = l_ref[...] * corr + p.sum(axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * corr + p @ v
+
+    @pl.when(ki == n_kv - 1)
+    def _finish():
+        o_ref[0, 0] = (
+            acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_kv", "interpret")
+)
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = False,
+    block_q: int = 128,
+    block_kv: int = 128,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Shapes [B, S, H, D] → [B, S, H, D]. S must divide by the blocks.
+
+    Differentiable via custom_vjp: the forward pass is the pallas kernel;
+    the backward pass recomputes attention with stable reference math
+    (dedicated backward kernel is a planned optimization)."""
+    return _flash_vjp(q, k, v, causal, block_q, block_kv, interpret)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_vjp(q, k, v, causal, block_q, block_kv, interpret):
+    return _flash_forward(q, k, v, causal, block_q, block_kv, interpret)
+
+
+def _flash_fwd_rule(q, k, v, causal, block_q, block_kv, interpret):
+    out = _flash_forward(q, k, v, causal, block_q, block_kv, interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd_rule(causal, block_q, block_kv, interpret, res, g):
+    q, k, v = res
+
+    def ref(q, k, v):
+        from raydp_tpu.ops.attention import reference_attention
+
+        return reference_attention(q, k, v, causal=causal)
+
+    _, vjp = jax.vjp(ref, q, k, v)
+    return vjp(g)
+
+
+_flash_vjp.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def _flash_forward(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool,
+    block_q: int,
+    block_kv: int,
+    interpret: bool,
+) -> jnp.ndarray:
+    b, s, h, d = q.shape
+    block_q = min(block_q, s)
+    block_kv = min(block_kv, s)
+    if s % block_q or s % block_kv:
+        raise ValueError(f"seq len {s} not divisible by blocks "
+                         f"({block_q}, {block_kv})")
+    scale = 1.0 / math.sqrt(d)
+
+    # [B, S, H, D] → [B, H, S, D] for row-major q/kv tiles.
+    qt = jnp.einsum("bshd->bhsd", q)
+    kt = jnp.einsum("bshd->bhsd", k)
+    vt = jnp.einsum("bshd->bhsd", v)
+
+    grid = (b, h, s // block_q, s // block_kv)
+    kernel = functools.partial(
+        _flash_kernel,
+        block_kv=block_kv,
+        causal=causal,
+        scale=scale,
+        q_block=block_q,
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_kv, d), lambda bi, hi, qi, ki: (bi, hi, ki, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_kv, d), lambda bi, hi, qi, ki: (bi, hi, ki, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+    return jnp.einsum("bhsd->bshd", out)

@@ -114,8 +114,14 @@ class JAXEstimator:
     def _ensure_mesh(self):
         if self._mesh is None:
             if self.mesh_spec.size > len(jax.devices()):
-                # Degrade to all available devices on the dp axis.
-                self.mesh_spec = MeshSpec.auto_from(len(jax.devices()))
+                # An explicitly requested mesh that doesn't fit is a
+                # misconfiguration — fail loudly instead of silently
+                # training at a fraction of the requested scale.
+                raise ValueError(
+                    f"mesh {self.mesh_spec.axis_sizes} needs "
+                    f"{self.mesh_spec.size} devices but only "
+                    f"{len(jax.devices())} are visible"
+                )
             self._mesh = self.mesh_spec.build()
         return self._mesh
 
@@ -181,7 +187,9 @@ class JAXEstimator:
         """Global batch → mesh-sharded device arrays. The batch dim splits
         over dp; XLA derives the gradient psum from these shardings."""
         sharding = self.data_sharding
-        pad = (-len(x)) % self.mesh_spec.size
+        # Only the dp axis shards the batch; padding to the full mesh size
+        # would duplicate rows needlessly on dp+tp/sp meshes.
+        pad = (-len(x)) % self.mesh_spec.dp
         if pad:
             # SPMD needs equal per-device slices; pad by cycling existing
             # rows (pad may exceed len(x) for tiny batches on big meshes).
@@ -226,7 +234,10 @@ class JAXEstimator:
         rng = jax.random.PRNGKey(self.seed + 1)
         for epoch in range(epochs):
             t0 = time.perf_counter()
-            train_loss, n_batches, n_samples = 0.0, 0, 0
+            # Accumulate the loss ON DEVICE: a float() per step would sync
+            # host↔device and serialize the prefetch/double-buffer pipeline.
+            loss_sum = None
+            n_batches, n_samples = 0, 0
             for loader in loaders:
                 for x, y in loader:
                     if self._state is None:
@@ -236,18 +247,22 @@ class JAXEstimator:
                     self._state, loss_val = self._train_step(
                         self._state, xd, yd, step_rng
                     )
-                    train_loss += float(loss_val)
+                    loss_sum = loss_val if loss_sum is None else loss_sum + loss_val
                     n_batches += 1
                     n_samples += len(x)
                     if self.log_every and n_batches % self.log_every == 0:
                         logger.info(
                             "epoch %d step %d loss %.5f",
-                            epoch, n_batches, float(loss_val),
+                            epoch, n_batches, float(loss_val),  # sync: opt-in
                         )
+            train_loss = float(loss_sum) / max(1, n_batches) if (
+                loss_sum is not None
+            ) else 0.0
             metrics: Dict[str, float] = {
                 "epoch": epoch,
-                "train_loss": train_loss / max(1, n_batches),
+                "train_loss": train_loss,
                 "time_s": time.perf_counter() - t0,
+                "samples": n_samples,
                 "samples_per_sec": (
                     n_samples / max(1e-9, time.perf_counter() - t0)
                 ),
@@ -309,17 +324,22 @@ class JAXEstimator:
             self._eval_loader_cache = (ds, loaders)
         else:
             loaders = cache[1]
+        # Batch means are weighted by true (unpadded) sample counts; the
+        # only residual bias is <= dp-1 duplicated rows inside the final
+        # partial batch.
         totals: Dict[str, float] = {}
-        count = 0
+        weight_total = 0.0
         for loader in loaders:
             for x, y in loader:
+                w = float(len(x))
                 xd, yd = self._shard_batch(x, y)
                 out = self._eval_step(self._state, xd, yd)
                 for k, v in out.items():
-                    totals[k] = totals.get(k, 0.0) + float(v)
-                count += 1
+                    totals[k] = totals.get(k, 0.0) + float(v) * w
+                weight_total += w
         return {
-            f"{prefix}{k}": v / max(1, count) for k, v in totals.items()
+            f"{prefix}{k}": v / max(1e-9, weight_total)
+            for k, v in totals.items()
         }
 
     # -- model access / persistence -------------------------------------
