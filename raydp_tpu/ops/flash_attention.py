@@ -2,8 +2,9 @@
 
 Online-softmax blockwise attention keeping scores in VMEM — the MXU does
 q@k^T and p@v per tile; HBM traffic is O(S·D) instead of O(S²). Grid is
-(batch, heads, q_blocks); the kv loop runs inside the kernel with running
-(max, sum, acc) carries.
+(batch, heads, q_blocks, kv_blocks) with kv as the innermost sequential
+grid dimension — each step gets one K/V tile via BlockSpec DMA while the
+running (max, sum, acc) live in scratch across kv steps.
 
 Falls back to interpret mode off-TPU (pallas guide: Debugging) so tests
 exercise identical code paths on the CPU mesh.

@@ -286,7 +286,12 @@ class JAXEstimator:
         num_shards: int = 1,
     ) -> List[Dict[str, float]]:
         """ETL handoff entry (reference: fit_on_spark,
-        torch/estimator.py:300-313): DataFrame → MLDataset → fit."""
+        torch/estimator.py:300-313): DataFrame → MLDataset → fit.
+
+        Accepts a raydp_tpu DataFrame or a pandas DataFrame (mirroring the
+        reference's koalas→spark auto-convert, interfaces.py:28-30)."""
+        train_df = _ensure_df(train_df)
+        evaluate_df = _ensure_df(evaluate_df)
         train_ds = MLDataset.from_df(
             train_df, num_shards=num_shards, shuffle=self.shuffle,
             shuffle_seed=self.seed,
@@ -327,7 +332,9 @@ class JAXEstimator:
         # Batch means are weighted by true (unpadded) sample counts; the
         # only residual bias is <= dp-1 duplicated rows inside the final
         # partial batch.
-        totals: Dict[str, float] = {}
+        # Accumulate ON DEVICE (a float(v) per batch would sync host↔device
+        # and defeat the loader's prefetch, just like in fit()).
+        totals: Dict[str, Any] = {}
         weight_total = 0.0
         for loader in loaders:
             for x, y in loader:
@@ -335,10 +342,11 @@ class JAXEstimator:
                 xd, yd = self._shard_batch(x, y)
                 out = self._eval_step(self._state, xd, yd)
                 for k, v in out.items():
-                    totals[k] = totals.get(k, 0.0) + float(v) * w
+                    vw = v * w
+                    totals[k] = vw if k not in totals else totals[k] + vw
                 weight_total += w
         return {
-            f"{prefix}{k}": v / max(1e-9, weight_total)
+            f"{prefix}{k}": float(v) / max(1e-9, weight_total)
             for k, v in totals.items()
         }
 
@@ -416,6 +424,18 @@ class JAXEstimator:
         self._state = None
         self._train_step = None
         self._eval_step = None
+
+
+def _ensure_df(df):
+    if df is None:
+        return None
+    import pandas as pd
+
+    if isinstance(df, pd.DataFrame):
+        from raydp_tpu.dataframe.io import from_pandas
+
+        return from_pandas(df)
+    return df
 
 
 def _is_module(obj) -> bool:
