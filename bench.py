@@ -60,7 +60,6 @@ def bench_torch_baseline(x, y) -> float:
     import torch
     from torch.utils.data import DataLoader, TensorDataset
 
-    torch.set_num_threads(max(1, torch.get_num_threads()))
     model = torch.nn.Sequential(
         torch.nn.Linear(N_FEATURES, 256), torch.nn.ReLU(),
         torch.nn.Linear(256, 128), torch.nn.ReLU(),

@@ -74,6 +74,8 @@ class JAXEstimator:
         callbacks: Sequence[TrainingCallback] = (),
         log_every: int = 0,
         checkpoint_dir: Optional[str] = None,
+        epoch_mode: str = "auto",
+        scan_threshold_bytes: int = 2 << 30,
     ):
         self._model = model() if callable(model) and not _is_module(model) else model
         if optimizer is None:
@@ -103,6 +105,12 @@ class JAXEstimator:
         self.callbacks = list(callbacks)
         self.log_every = log_every
         self.checkpoint_dir = checkpoint_dir
+        if epoch_mode not in ("auto", "stream", "scan"):
+            raise ValueError(
+                f"epoch_mode must be auto|stream|scan, got {epoch_mode!r}"
+            )
+        self.epoch_mode = epoch_mode
+        self.scan_threshold_bytes = scan_threshold_bytes
 
         self._mesh = None
         self._state: Optional[TrainState] = None
@@ -145,9 +153,10 @@ class JAXEstimator:
         self._state = jax.device_put(state, self.replicated)
         self._build_steps()
 
-    def _build_steps(self) -> None:
+    def _make_train_step(self):
+        """The (state, x, y, rng) → (state, loss) step shared by the
+        stream and scan paths."""
         loss_fn = self._loss_fn
-        metric_fns = list(self._metrics)
         takes_deterministic = self._model_takes_deterministic()
 
         def train_step(state: TrainState, x, y, rng):
@@ -163,6 +172,13 @@ class JAXEstimator:
 
             loss_val, grads = jax.value_and_grad(compute)(state.params)
             return state.apply_gradients(grads=grads), loss_val
+
+        return train_step
+
+    def _build_steps(self) -> None:
+        loss_fn = self._loss_fn
+        metric_fns = list(self._metrics)
+        train_step = self._make_train_step()
 
         def eval_step(state: TrainState, x, y):
             preds = state.apply_fn(state.params, x)
@@ -201,6 +217,33 @@ class JAXEstimator:
         yd = jax.device_put(y, sharding) if y is not None else None
         return xd, yd
 
+    def _finish_epoch(
+        self,
+        epoch: int,
+        t0: float,
+        train_loss: float,
+        n_samples: int,
+        evaluate_ds: Optional[MLDataset],
+    ) -> Dict[str, float]:
+        """Per-epoch tail shared by stream and scan paths: metrics dict,
+        optional eval, callbacks, checkpoint."""
+        dt = time.perf_counter() - t0
+        metrics: Dict[str, float] = {
+            "epoch": epoch,
+            "train_loss": train_loss,
+            "time_s": dt,
+            "samples": n_samples,
+            "samples_per_sec": n_samples / max(1e-9, dt),
+        }
+        if evaluate_ds is not None:
+            metrics.update(self.evaluate(evaluate_ds, prefix="eval_"))
+        self.history.append(metrics)
+        for cb in self.callbacks:
+            cb.on_epoch_end(epoch, metrics)
+        if self.checkpoint_dir:
+            self.save(self.checkpoint_dir, step=epoch)
+        return metrics
+
     # -- training -------------------------------------------------------
     def fit(
         self,
@@ -213,6 +256,8 @@ class JAXEstimator:
                 "feature_columns and label_column must be configured"
             )
         epochs = num_epochs if num_epochs is not None else self.num_epochs
+        if self._use_scan(train_ds):
+            return self._fit_scan(train_ds, evaluate_ds, epochs)
         # One loader per shard: a multi-shard dataset is consumed in full
         # (shards chained within each epoch), never silently truncated to
         # shard 0.
@@ -258,22 +303,127 @@ class JAXEstimator:
             train_loss = float(loss_sum) / max(1, n_batches) if (
                 loss_sum is not None
             ) else 0.0
-            metrics: Dict[str, float] = {
-                "epoch": epoch,
-                "train_loss": train_loss,
-                "time_s": time.perf_counter() - t0,
-                "samples": n_samples,
-                "samples_per_sec": (
-                    n_samples / max(1e-9, time.perf_counter() - t0)
-                ),
-            }
-            if evaluate_ds is not None:
-                metrics.update(self.evaluate(evaluate_ds, prefix="eval_"))
-            self.history.append(metrics)
-            for cb in self.callbacks:
-                cb.on_epoch_end(epoch, metrics)
-            if self.checkpoint_dir:
-                self.save(self.checkpoint_dir, step=epoch)
+            self._finish_epoch(epoch, t0, train_loss, n_samples, evaluate_ds)
+        for cb in self.callbacks:
+            cb.on_train_end(self.history)
+        return self.history
+
+    # -- scan (fused-epoch) path ----------------------------------------
+    def _use_scan(self, train_ds: MLDataset) -> bool:
+        """Scan epochs when the dataset fits comfortably in HBM.
+
+        TPU-first: per-batch Python dispatch + host→device transfer costs
+        more than a small dataset's entire epoch. Below the threshold the
+        shard is uploaded ONCE and each epoch is a single jitted
+        ``lax.scan`` over minibatches — one dispatch per epoch, weights
+        and data resident in HBM throughout.
+        """
+        if self.epoch_mode == "stream":
+            return False
+        try:
+            n_rows = train_ds.total_rows
+        except AttributeError:
+            return False
+        if n_rows == 0:
+            # The stream path degrades gracefully on empty data; scan
+            # cannot build even one batch.
+            return False
+        if self.epoch_mode == "scan":
+            return True
+        n_cols = len(self.feature_columns) + 1
+        approx = n_rows * n_cols * max(
+            np.dtype(self.feature_dtype).itemsize,
+            np.dtype(self.label_dtype).itemsize,
+        )
+        return approx <= self.scan_threshold_bytes
+
+    def _materialize_all(self, ds: MLDataset):
+        """All shards → one (x, y) pair of host arrays."""
+        wanted = list(self.feature_columns) + [self.label_column]
+        xs, ys = [], []
+        for rank in range(ds.num_shards):
+            cols = ds.shard_columns(rank, wanted)
+            xs.append(
+                np.stack(
+                    [
+                        cols[c].astype(self.feature_dtype, copy=False)
+                        for c in self.feature_columns
+                    ],
+                    axis=1,
+                )
+            )
+            ys.append(
+                cols[self.label_column].astype(self.label_dtype, copy=False)
+            )
+        x = np.concatenate(xs) if len(xs) > 1 else xs[0]
+        y = np.concatenate(ys) if len(ys) > 1 else ys[0]
+        return x, y
+
+    def _build_epoch_fn(self, n_steps: int, batch: int):
+        train_step = self._make_train_step()
+        shuffle = self.shuffle
+
+        def epoch_fn(state, x, y, key):
+            n = x.shape[0]
+            if shuffle:
+                perm = jax.random.permutation(key, n)
+                x = x[perm]
+                y = y[perm]
+            xb = x.reshape((n_steps, batch) + x.shape[1:])
+            yb = y.reshape((n_steps, batch) + y.shape[1:])
+
+            def body(state, inp):
+                xs, ys, step = inp
+                step_key = jax.random.fold_in(key, step)
+                state, loss_val = train_step(state, xs, ys, step_key)
+                return state, loss_val
+
+            state, losses = jax.lax.scan(
+                body, state, (xb, yb, jnp.arange(n_steps))
+            )
+            return state, losses.mean()
+
+        return jax.jit(epoch_fn, donate_argnums=0)
+
+    def _fit_scan(
+        self,
+        train_ds: MLDataset,
+        evaluate_ds: Optional[MLDataset],
+        epochs: int,
+    ) -> List[Dict[str, float]]:
+        x, y = self._materialize_all(train_ds)
+        n_true = len(x)
+        if self._state is None:
+            self._init_state(x[:1])
+        # Pad to steps × batch with batch divisible by dp; padded rows are
+        # cycled duplicates (same convention as _shard_batch).
+        batch = self.batch_size + (-self.batch_size) % self.mesh_spec.dp
+        n_steps = max(1, (n_true + batch - 1) // batch)
+        pad = n_steps * batch - n_true
+        if pad:
+            idx = np.arange(pad) % n_true
+            x = np.concatenate([x, x[idx]])
+            y = np.concatenate([y, y[idx]])
+        sharding = self.data_sharding
+        xd = jax.device_put(x, sharding)
+        yd = jax.device_put(y, sharding)
+        epoch_fn = self._build_epoch_fn(n_steps, batch)
+        rng = jax.random.PRNGKey(self.seed + 1)
+        for epoch in range(epochs):
+            t0 = time.perf_counter()
+            rng, key = jax.random.split(rng)
+            self._state, mean_loss = epoch_fn(self._state, xd, yd, key)
+            train_loss = float(mean_loss)  # one sync per epoch
+            # True-sample throughput: padded duplicate rows don't count.
+            metrics = self._finish_epoch(
+                epoch, t0, train_loss, n_true, evaluate_ds
+            )
+            if self.log_every:
+                # Scan epochs have no per-step host loop; log per epoch.
+                logger.info(
+                    "epoch %d (%d fused steps) loss %.5f",
+                    epoch, n_steps, metrics["train_loss"],
+                )
         for cb in self.callbacks:
             cb.on_train_end(self.history)
         return self.history

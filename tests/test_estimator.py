@@ -269,3 +269,61 @@ def test_num_epochs_zero():
     )
     est.fit(MLDataset.from_df(_linear_df(64), 1), num_epochs=0)
     assert est.history == []
+
+
+def test_scan_and_stream_modes_agree():
+    # Same data, both epoch modes: each must converge to a small loss.
+    results = {}
+    for mode in ("scan", "stream"):
+        est = JAXEstimator(
+            model=MLP(hidden=(32, 16), out_dim=1),
+            optimizer=optax.adam(1e-2),
+            num_epochs=6,
+            batch_size=256,
+            feature_columns=["a", "b"],
+            label_column="y",
+            seed=3,
+            epoch_mode=mode,
+        )
+        est.fit_on_df(_linear_df(2048, seed=3))
+        results[mode] = est.history[-1]["train_loss"]
+    assert results["scan"] < 0.2
+    assert results["stream"] < 0.2
+    assert abs(results["scan"] - results["stream"]) < 0.1
+
+
+def test_auto_mode_picks_scan_for_small_data():
+    est = JAXEstimator(
+        model=MLP(hidden=(8,), out_dim=1),
+        num_epochs=1,
+        batch_size=64,
+        feature_columns=["a", "b"],
+        label_column="y",
+    )
+    ds = MLDataset.from_df(_linear_df(256), 1)
+    assert est._use_scan(ds)
+    est.scan_threshold_bytes = 10  # force over threshold
+    assert not est._use_scan(ds)
+
+
+def test_scan_mode_on_mesh(eight_cpu_devices):
+    est = JAXEstimator(
+        model=MLP(hidden=(16,), out_dim=1),
+        optimizer=optax.adam(1e-2),
+        num_epochs=4,
+        batch_size=250,  # not divisible by dp=8: exercises batch round-up
+        feature_columns=["a", "b"],
+        label_column="y",
+        mesh=MeshSpec(dp=8),
+        epoch_mode="scan",
+    )
+    est.fit_on_df(_linear_df(2048, seed=5))
+    assert est.history[-1]["train_loss"] < est.history[0]["train_loss"]
+
+
+def test_bad_epoch_mode_rejected():
+    with pytest.raises(ValueError):
+        JAXEstimator(
+            model=MLP(hidden=(4,)), epoch_mode="warp",
+            feature_columns=["a"], label_column="y",
+        )
