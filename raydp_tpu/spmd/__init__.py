@@ -1,0 +1,33 @@
+"""SPMD host-process job runner.
+
+The reference's MPI-on-Ray capability (reference: python/raydp/mpi/
+__init__.py:94 exports create_mpi_job, MPIJobContext, WorkerContext)
+rebuilt TPU-first: gang launch + function shipping over the framework's
+single gRPC transport, with ``jax.distributed`` as the collective fabric
+instead of MPI. See :mod:`raydp_tpu.spmd.job`.
+"""
+from raydp_tpu.spmd.job import (  # noqa: F401
+    SPMDJob,
+    SPMDJobContext,
+    SPMDJobError,
+    create_spmd_job,
+)
+
+
+def __getattr__(name):
+    # Lazy: importing worker_main here would shadow `python -m
+    # raydp_tpu.spmd.worker_main` in the spawned rank processes
+    # (runpy double-import warning).
+    if name == "SPMDWorkerContext":
+        from raydp_tpu.spmd.worker_main import SPMDWorkerContext
+
+        return SPMDWorkerContext
+    raise AttributeError(name)
+
+__all__ = [
+    "create_spmd_job",
+    "SPMDJob",
+    "SPMDJobContext",
+    "SPMDJobError",
+    "SPMDWorkerContext",
+]

@@ -1,0 +1,206 @@
+"""SPMD job worker process.
+
+One rank of the gang launched by :class:`raydp_tpu.spmd.job.SPMDJob`.
+Registers with the driver, then executes shipped functions on a dedicated
+runner thread in strict ``func_id`` order (the reference's TaskRunner with
+monotonic-id check, reference: python/raydp/mpi/mpi_worker.py:63-96).
+
+Functions receive an :class:`SPMDWorkerContext`; for multi-host TPU work
+they call ``ctx.init_jax_distributed()`` which wires ``jax.distributed``
+to the driver-provisioned rank-0 coordinator, after which XLA collectives
+span the whole gang — the role MPI collectives play in the reference.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import sys
+import threading
+import traceback
+from typing import Optional
+
+import cloudpickle
+
+from raydp_tpu.cluster.rpc import RpcClient, RpcServer
+from raydp_tpu.spmd.job import (
+    DRIVER_SERVICE,
+    ENV_COORDINATOR,
+    ENV_DRIVER_ADDR,
+    ENV_JOB_NAME,
+    ENV_PROCS_PER_NODE,
+    ENV_RANK,
+    ENV_WORLD_SIZE,
+    WORKER_SERVICE,
+)
+from raydp_tpu.utils.net import local_ip
+
+logger = logging.getLogger(__name__)
+
+
+class SPMDWorkerContext:
+    """First argument to every shipped function
+    (reference: WorkerContext, mpi/mpi_worker.py:45-60)."""
+
+    def __init__(self, job_name: str, rank: int, world_size: int,
+                 local_rank: int, node_ip: str, coordinator_address: str):
+        self.job_name = job_name
+        self.rank = rank
+        self.world_size = world_size
+        self.local_rank = local_rank
+        self.node_ip = node_ip
+        self.coordinator_address = coordinator_address
+        self._jax_initialized = False
+
+    def init_jax_distributed(self) -> None:
+        """Join the gang's jax.distributed coordination service; after this
+        ``jax.devices()`` spans all ranks' chips and pjit collectives run
+        over ICI/DCN. Idempotent per process."""
+        if self._jax_initialized:
+            return
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=self.coordinator_address,
+            num_processes=self.world_size,
+            process_id=self.rank,
+        )
+        self._jax_initialized = True
+
+
+class SPMDWorker:
+    def __init__(self):
+        self.job_name = os.environ[ENV_JOB_NAME]
+        self.rank = int(os.environ[ENV_RANK])
+        self.world_size = int(os.environ[ENV_WORLD_SIZE])
+        procs_per_node = int(os.environ.get(ENV_PROCS_PER_NODE, "1"))
+        self.ctx = SPMDWorkerContext(
+            self.job_name,
+            self.rank,
+            self.world_size,
+            local_rank=self.rank % procs_per_node,
+            node_ip=local_ip(),
+            coordinator_address=os.environ[ENV_COORDINATOR],
+        )
+        self.driver = RpcClient(os.environ[ENV_DRIVER_ADDR], DRIVER_SERVICE)
+        self._queue: "queue.Queue[Optional[dict]]" = queue.Queue()
+        self._stop_event = threading.Event()
+        self._last_func_id = 0
+        self._server = RpcServer(
+            WORKER_SERVICE,
+            {
+                "RunFunction": self._on_run_function,
+                "Stop": self._on_stop,
+            },
+        )
+
+    def _on_run_function(self, req: dict) -> dict:
+        self._queue.put(req)
+        return {"queued": req["func_id"]}
+
+    def _on_stop(self, req: dict) -> dict:
+        self._stop_event.set()
+        self._queue.put(None)
+        return {"stopping": True}
+
+    def _runner(self) -> None:
+        while not self._stop_event.is_set():
+            item = self._queue.get()
+            if item is None:
+                return
+            func_id = item["func_id"]
+            if func_id <= self._last_func_id:
+                # Duplicate delivery — the driver's ids only move forward.
+                continue
+            self._last_func_id = func_id
+            value, error = None, None
+            try:
+                fn = cloudpickle.loads(item["fn"])
+                value = fn(self.ctx)
+            except Exception:
+                error = traceback.format_exc()
+            reply = self.driver.try_call(
+                "FuncResult",
+                {
+                    "func_id": func_id,
+                    "rank": self.rank,
+                    "value": value,
+                    "error": error,
+                },
+                timeout=10.0,
+            )
+            if reply is None:
+                logger.warning(
+                    "rank %d: driver unreachable posting result %d; exiting",
+                    self.rank, func_id,
+                )
+                self._stop_event.set()
+                return
+
+    def _heartbeat(self) -> None:
+        """Detect a dead driver while idle — without this, a SIGKILLed
+        driver would orphan the whole gang (and the chips it holds)
+        forever; result-posting only notices mid-function."""
+        missed = 0
+        while not self._stop_event.wait(5.0):
+            if self.driver.try_call("Ping", {}, timeout=5.0) is None:
+                missed += 1
+                if missed >= 3:
+                    logger.warning(
+                        "rank %d: driver unreachable for %d beats; exiting",
+                        self.rank, missed,
+                    )
+                    self._stop_event.set()
+                    self._queue.put(None)
+                    return
+            else:
+                missed = 0
+
+    def run(self) -> int:
+        self.driver.call(
+            "RegisterWorker",
+            {
+                "rank": self.rank,
+                "address": self._server.address,
+                "host": self.ctx.node_ip,
+                "pid": os.getpid(),
+            },
+        )
+        runner = threading.Thread(target=self._runner, daemon=True)
+        runner.start()
+        threading.Thread(target=self._heartbeat, daemon=True).start()
+        self._stop_event.wait()
+        runner.join(timeout=2.0)
+        self._server.stop()
+        self.driver.close()
+        return 0
+
+
+def main() -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format=f"[spmd-{os.environ.get(ENV_RANK, '?')}] %(levelname)s %(message)s",
+    )
+    try:
+        return SPMDWorker().run()
+    except Exception:
+        traceback.print_exc()
+        # Best-effort failure report so the driver fails fast rather than
+        # timing out (reference: mpirun watcher failed_callback,
+        # mpi/mpi_job.py:265-271).
+        try:
+            RpcClient(
+                os.environ[ENV_DRIVER_ADDR], DRIVER_SERVICE
+            ).try_call(
+                "JobFailed",
+                {"reason": f"rank {os.environ.get(ENV_RANK)}: "
+                           f"{traceback.format_exc(limit=3)}"},
+                timeout=2.0,
+            )
+        except Exception:
+            pass
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -207,12 +207,7 @@ class JAXEstimator:
         # would duplicate rows needlessly on dp+tp/sp meshes.
         pad = (-len(x)) % self.mesh_spec.dp
         if pad:
-            # SPMD needs equal per-device slices; pad by cycling existing
-            # rows (pad may exceed len(x) for tiny batches on big meshes).
-            idx = np.arange(pad) % len(x)
-            x = np.concatenate([x, x[idx]])
-            if y is not None:
-                y = np.concatenate([y, y[idx]])
+            x, y = _pad_cycle(x, y, pad)
         xd = jax.device_put(x, sharding)
         yd = jax.device_put(y, sharding) if y is not None else None
         return xd, yd
@@ -323,13 +318,22 @@ class JAXEstimator:
         try:
             n_rows = train_ds.total_rows
         except AttributeError:
-            return False
+            n_rows = None
         if n_rows == 0:
             # The stream path degrades gracefully on empty data; scan
             # cannot build even one batch.
+            if self.epoch_mode == "scan":
+                logger.warning(
+                    "epoch_mode='scan' requested but dataset is empty; "
+                    "falling back to the stream path"
+                )
             return False
         if self.epoch_mode == "scan":
+            # Explicit opt-in wins even when total_rows is unavailable;
+            # _fit_scan only needs shard_columns/num_shards.
             return True
+        if n_rows is None:
+            return False
         n_cols = len(self.feature_columns) + 1
         approx = n_rows * n_cols * max(
             np.dtype(self.feature_dtype).itemsize,
@@ -401,9 +405,7 @@ class JAXEstimator:
         n_steps = max(1, (n_true + batch - 1) // batch)
         pad = n_steps * batch - n_true
         if pad:
-            idx = np.arange(pad) % n_true
-            x = np.concatenate([x, x[idx]])
-            y = np.concatenate([y, y[idx]])
+            x, y = _pad_cycle(x, y, pad)
         sharding = self.data_sharding
         xd = jax.device_put(x, sharding)
         yd = jax.device_put(y, sharding)
@@ -574,6 +576,17 @@ class JAXEstimator:
         self._state = None
         self._train_step = None
         self._eval_step = None
+
+
+def _pad_cycle(x, y, pad: int):
+    """Pad by cycling existing rows — SPMD needs equal per-device slices;
+    ``pad`` may exceed ``len(x)`` for tiny batches on big meshes. The one
+    padding convention for both the stream and scan paths."""
+    idx = np.arange(pad) % len(x)
+    x = np.concatenate([x, x[idx]])
+    if y is not None:
+        y = np.concatenate([y, y[idx]])
+    return x, y
 
 
 def _ensure_df(df):

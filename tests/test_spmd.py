@@ -1,0 +1,118 @@
+"""SPMD job runner tests.
+
+Shape mirrors the reference's MPI tests (reference:
+python/raydp/tests/test_mpi.py:28-121): start/run/restart, rank
+addresses, custom launch fn + env propagation — against real spawned
+processes, no mocks.
+"""
+import os
+
+import pytest
+
+from raydp_tpu.spmd import SPMDJobError, create_spmd_job
+
+WORLD = 3
+
+
+def test_start_run_restart():
+    job = create_spmd_job("t-basic", world_size=WORLD, timeout=45)
+    job.start()
+    try:
+        ranks = job.run(lambda ctx: ctx.rank)
+        assert ranks == list(range(WORLD))
+
+        # func ids are monotonic: a second run works and is distinct
+        doubles = job.run(lambda ctx: ctx.rank * 2)
+        assert doubles == [0, 2, 4]
+
+        # restart: stop, start, run again (reference: test_mpi.py:42-55)
+        job.stop()
+        job.start()
+        assert job.run(lambda ctx: ctx.world_size) == [WORLD] * WORLD
+    finally:
+        job.stop()
+
+
+def test_context_fields_and_addresses():
+    with create_spmd_job("t-addrs", world_size=2, timeout=45) as job:
+        metas = job.run(
+            lambda ctx: (ctx.job_name, ctx.rank, ctx.world_size,
+                         ctx.local_rank, ctx.node_ip)
+        )
+        assert [m[1] for m in metas] == [0, 1]
+        assert all(m[0] == "t-addrs" and m[2] == 2 for m in metas)
+        addrs = job.get_rank_addresses()
+        assert len(addrs) == 2
+        assert addrs[0] == metas[0][4]
+
+
+def test_env_propagation_and_prepare_fn():
+    seen_ctx = {}
+
+    def prepare(ctx):
+        seen_ctx["world"] = ctx.world_size
+        ctx.add_env("RAYDP_TEST_FLAG", "42")
+        return []  # no launcher prefix
+
+    with create_spmd_job(
+        "t-env", world_size=2, script_prepare_fn=prepare,
+        env={"RAYDP_TEST_BASE": "base"}, timeout=45,
+    ) as job:
+        vals = job.run(
+            lambda ctx: (os.environ.get("RAYDP_TEST_FLAG"),
+                         os.environ.get("RAYDP_TEST_BASE"))
+        )
+    assert seen_ctx["world"] == 2
+    assert vals == [("42", "base")] * 2
+
+
+def test_function_error_surfaces():
+    def boom(ctx):
+        if ctx.rank == 1:
+            raise ValueError("rank 1 exploded")
+        return "ok"
+
+    with create_spmd_job("t-err", world_size=2, timeout=45) as job:
+        with pytest.raises(SPMDJobError, match="rank 1"):
+            job.run(boom)
+        # the gang survives a function error; next run still works
+        assert job.run(lambda ctx: "alive") == ["alive", "alive"]
+
+
+def test_run_before_start_raises():
+    job = create_spmd_job("t-nostart", world_size=1)
+    with pytest.raises(SPMDJobError, match="not started"):
+        job.run(lambda ctx: None)
+
+
+def test_startup_crash_fails_fast():
+    # A rank that dies at launch must fail start() well before the
+    # registration timeout, via the JobFailed report / exit watcher.
+    import time
+
+    job = create_spmd_job(
+        # /bin/false as launcher prefix: every rank exits 1 instantly
+        "t-crash", world_size=2, script_prepare_fn=lambda ctx: ["/bin/false"],
+        timeout=120,
+    )
+    t0 = time.time()
+    with pytest.raises(SPMDJobError):
+        job.start()
+    assert time.time() - t0 < 60  # not the full 120s registration timeout
+    job.stop()
+
+    # the job object is reusable after the failed start
+    job2 = create_spmd_job("t-crash2", world_size=1, timeout=45)
+    job2.start()
+    try:
+        assert job2.run(lambda ctx: "recovered") == ["recovered"]
+    finally:
+        job2.stop()
+
+
+def test_coordinator_address_shared():
+    with create_spmd_job("t-coord", world_size=2, timeout=45) as job:
+        coords = job.run(lambda ctx: ctx.coordinator_address)
+    assert coords[0] == coords[1]
+    host, port = coords[0].rsplit(":", 1)
+    assert int(port) > 0
