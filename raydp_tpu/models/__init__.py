@@ -1,3 +1,23 @@
 from raydp_tpu.models.mlp import MLP, binary_classifier, taxi_fare_regressor
+from raydp_tpu.models.transformer import (
+    CausalLM,
+    SequenceClassifier,
+    TransformerConfig,
+    TransformerEncoder,
+    bert_base,
+    param_shardings,
+    tiny_transformer,
+)
 
-__all__ = ["MLP", "binary_classifier", "taxi_fare_regressor"]
+__all__ = [
+    "MLP",
+    "binary_classifier",
+    "taxi_fare_regressor",
+    "TransformerConfig",
+    "TransformerEncoder",
+    "SequenceClassifier",
+    "CausalLM",
+    "bert_base",
+    "tiny_transformer",
+    "param_shardings",
+]

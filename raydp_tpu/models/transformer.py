@@ -1,0 +1,325 @@
+"""Transformer model family: BERT-style encoder, GLUE classifier, causal LM.
+
+New capability relative to the reference (SURVEY §2.4, §5.7: the
+reference ships no attention code at all — models live in user examples);
+this is the BERT-GLUE benchmark config of BASELINE.md and the flagship
+for tensor/sequence parallelism.
+
+TPU-first design:
+
+* Every kernel carries flax *logical axis* metadata
+  (``nn.with_logical_partitioning``); :data:`LOGICAL_RULES` maps logical
+  axes onto the ``dp/tp/sp`` mesh — megatron-style TP (QKV and MLP
+  up-projection column-sharded over ``tp``, output projections
+  row-sharded) with XLA inserting the psums, not hand-written NCCL.
+* Widths are MXU-friendly (d_model, d_ff multiples of 128); compute
+  dtype defaults to bfloat16 with float32 params.
+* Attention is pluggable: ``dense`` (XLA softmax attention), ``ring``
+  (sequence-parallel K/V rotation over the ``sp`` ICI ring), ``ulysses``
+  (head-sharded all_to_all), ``flash`` (Pallas kernel) — see
+  raydp_tpu.ops.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from raydp_tpu.ops.attention import (
+    reference_attention,
+    ring_attention,
+    ulysses_attention,
+)
+
+# Logical axis → mesh axis. None keeps the axis replicated.
+LOGICAL_RULES: Tuple[Tuple[str, Optional[str]], ...] = (
+    ("batch", "dp"),
+    ("seq", "sp"),
+    ("vocab", None),
+    ("embed", None),
+    ("heads", "tp"),
+    ("kv", None),
+    ("mlp", "tp"),
+    ("pooled", None),
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 30522          # BERT wordpiece vocab
+    d_model: int = 768
+    n_heads: int = 12
+    n_layers: int = 12
+    d_ff: int = 3072
+    max_len: int = 512
+    n_segments: int = 2
+    dropout_rate: float = 0.1
+    causal: bool = False
+    attention_impl: str = "dense"    # dense | ring | ulysses | flash
+    dtype: Any = jnp.bfloat16        # compute dtype (MXU-friendly)
+    param_dtype: Any = jnp.float32
+    mesh: Any = None                 # required for ring/ulysses
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+
+def _dense_init(*logical_axes: str):
+    return nn.with_logical_partitioning(
+        nn.initializers.xavier_uniform(), logical_axes
+    )
+
+
+def _embed_init(*logical_axes: str):
+    return nn.with_logical_partitioning(
+        nn.initializers.normal(stddev=0.02), logical_axes
+    )
+
+
+class MultiHeadAttention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.cfg
+        qkv = nn.DenseGeneral(
+            features=(3, cfg.n_heads, cfg.head_dim),
+            axis=-1,
+            kernel_init=_dense_init("embed", "qkv", "heads", "kv"),
+            use_bias=True,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            name="qkv",
+        )(x)
+        q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
+
+        if cfg.attention_impl == "dense":
+            out = reference_attention(q, k, v, causal=cfg.causal)
+        elif cfg.attention_impl == "ring":
+            out = ring_attention(
+                q, k, v, mesh=cfg.mesh, causal=cfg.causal
+            )
+        elif cfg.attention_impl == "ulysses":
+            out = ulysses_attention(
+                q, k, v, mesh=cfg.mesh, causal=cfg.causal
+            )
+        elif cfg.attention_impl == "flash":
+            from raydp_tpu.ops.flash_attention import flash_attention
+
+            out = flash_attention(
+                q, k, v, causal=cfg.causal,
+                interpret=jax.default_backend() == "cpu",
+            )
+        else:
+            raise ValueError(
+                f"unknown attention_impl {cfg.attention_impl!r}"
+            )
+
+        out = nn.DenseGeneral(
+            features=cfg.d_model,
+            axis=(-2, -1),
+            kernel_init=_dense_init("heads", "kv", "embed"),
+            use_bias=True,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            name="out",
+        )(out)
+        if cfg.dropout_rate > 0:
+            out = nn.Dropout(cfg.dropout_rate)(out, deterministic)
+        return out
+
+
+class TransformerBlock(nn.Module):
+    """Pre-LN encoder block (trains stably in bf16 without warmup tricks)."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.cfg
+        y = nn.LayerNorm(
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="ln_attn",
+            scale_init=nn.with_logical_partitioning(
+                nn.initializers.ones, ("embed",)
+            ),
+        )(x)
+        x = x + MultiHeadAttention(cfg, name="attn")(y, deterministic)
+
+        y = nn.LayerNorm(
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="ln_mlp",
+            scale_init=nn.with_logical_partitioning(
+                nn.initializers.ones, ("embed",)
+            ),
+        )(x)
+        y = nn.Dense(
+            cfg.d_ff,
+            kernel_init=_dense_init("embed", "mlp"),
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            name="mlp_up",
+        )(y)
+        y = nn.gelu(y)
+        y = nn.Dense(
+            cfg.d_model,
+            kernel_init=_dense_init("mlp", "embed"),
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            name="mlp_down",
+        )(y)
+        if cfg.dropout_rate > 0:
+            y = nn.Dropout(cfg.dropout_rate)(y, deterministic)
+        x = x + y
+        return nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+
+
+class TransformerEncoder(nn.Module):
+    """Token + position (+ optional segment) embeddings, N blocks, final LN.
+
+    Input: int32 token ids [B, S] (+ optional segment ids). Output:
+    [B, S, d_model] hidden states.
+    """
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, input_ids, segment_ids=None, deterministic: bool = True):
+        cfg = self.cfg
+        x = nn.Embed(
+            cfg.vocab_size, cfg.d_model,
+            embedding_init=_embed_init("vocab", "embed"),
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="tok_embed",
+        )(input_ids)
+        pos = jnp.arange(input_ids.shape[-1])[None, :]
+        x = x + nn.Embed(
+            cfg.max_len, cfg.d_model,
+            embedding_init=_embed_init("seq", "embed"),
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="pos_embed",
+        )(pos)
+        if segment_ids is not None:
+            x = x + nn.Embed(
+                cfg.n_segments, cfg.d_model,
+                embedding_init=_embed_init(None, "embed"),
+                dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                name="seg_embed",
+            )(segment_ids)
+        if cfg.dropout_rate > 0:
+            x = nn.Dropout(cfg.dropout_rate)(x, deterministic)
+        x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+        for i in range(cfg.n_layers):
+            x = TransformerBlock(cfg, name=f"block_{i}")(x, deterministic)
+        return nn.LayerNorm(
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="ln_final",
+            scale_init=nn.with_logical_partitioning(
+                nn.initializers.ones, ("embed",)
+            ),
+        )(x)
+
+
+class SequenceClassifier(nn.Module):
+    """Encoder + first-token pooler + classification head — the BERT-GLUE
+    fine-tune model (BASELINE.md config matrix, last row)."""
+
+    cfg: TransformerConfig
+    num_classes: int = 2
+
+    @nn.compact
+    def __call__(self, input_ids, segment_ids=None, deterministic: bool = True):
+        h = TransformerEncoder(self.cfg, name="encoder")(
+            input_ids, segment_ids, deterministic
+        )
+        pooled = nn.tanh(
+            nn.Dense(
+                self.cfg.d_model,
+                kernel_init=_dense_init("embed", "pooled"),
+                dtype=self.cfg.dtype,
+                param_dtype=self.cfg.param_dtype,
+                name="pooler",
+            )(h[:, 0])
+        )
+        # Logits in float32: bf16 is fine through the trunk but softmax/
+        # cross-entropy want full precision.
+        return nn.Dense(
+            self.num_classes,
+            kernel_init=_dense_init("embed", None),
+            dtype=jnp.float32,
+            param_dtype=self.cfg.param_dtype,
+            name="head",
+        )(pooled)
+
+
+class CausalLM(nn.Module):
+    """Decoder-only LM: the long-context flagship — pair with
+    ``attention_impl='ring'`` to scale sequence length over the sp axis."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, input_ids, deterministic: bool = True):
+        cfg = self.cfg
+        assert cfg.causal, "CausalLM requires cfg.causal=True"
+        h = TransformerEncoder(cfg, name="encoder")(
+            input_ids, None, deterministic
+        )
+        return nn.Dense(
+            cfg.vocab_size,
+            kernel_init=_dense_init("embed", "vocab"),
+            dtype=jnp.float32,
+            param_dtype=cfg.param_dtype,
+            name="lm_head",
+        )(h)
+
+
+# ---------------------------------------------------------------- factories
+
+def bert_base(**overrides) -> TransformerConfig:
+    """BERT-base (the GLUE fine-tune target)."""
+    return TransformerConfig(**overrides)
+
+
+def tiny_transformer(**overrides) -> TransformerConfig:
+    """Small MXU-aligned config for tests/dry runs (widths still /128)."""
+    defaults = dict(
+        vocab_size=1024, d_model=128, n_heads=8, n_layers=2, d_ff=256,
+        max_len=128, dropout_rate=0.0,
+    )
+    defaults.update(overrides)
+    return TransformerConfig(**defaults)
+
+
+# ------------------------------------------------------------- shardings
+
+def param_shardings(model: nn.Module, mesh, *example_args, rules=LOGICAL_RULES):
+    """Mesh shardings for every parameter, derived from the logical axis
+    metadata — the pjit weight-sharding story (SURVEY §2.4 "TP" row).
+
+    Returns (abstract_variables, shardings). Typical use::
+
+        _, shardings = param_shardings(model, mesh, ids)
+        params = jax.jit(lambda: nn.unbox(model.init(key, ids)),
+                         out_shardings=shardings)()
+
+    (``nn.unbox`` strips the logical-partitioning metadata boxes so the
+    tree is plain arrays for optax/checkpointing.)
+    """
+    abstract = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), *example_args)
+    )
+    logical = nn.get_partition_spec(abstract)
+    return abstract, nn.logical_to_mesh_sharding(
+        logical, mesh, effective_rules(mesh, rules)
+    )
+
+
+def effective_rules(mesh, rules=LOGICAL_RULES):
+    """Logical rules restricted to the axes this mesh actually has —
+    a dp×tp mesh simply replicates the seq axis rather than erroring on
+    the absent ``sp``."""
+    return [
+        (logical, axis if axis in mesh.axis_names else None)
+        for logical, axis in rules
+    ]

@@ -139,6 +139,9 @@ class SPMDJob:
         self.timeout = timeout
         self.hosts = hosts or ["127.0.0.1"]
         self.coordinator_port = coordinator_port
+        self._multihost = any(
+            h not in ("127.0.0.1", "localhost") for h in self.hosts
+        )
 
         self._server: Optional[RpcServer] = None
         self._procs: List[subprocess.Popen] = []
@@ -167,6 +170,11 @@ class SPMDJob:
         self._worker_addrs.clear()
         self._worker_hosts.clear()
 
+        # Multi-host gangs must reach the driver across the network: bind
+        # all interfaces and advertise the routable IP, not loopback.
+        from raydp_tpu.utils.net import local_ip
+
+        bind_host = "0.0.0.0" if self._multihost else "127.0.0.1"
         self._server = RpcServer(
             DRIVER_SERVICE,
             {
@@ -175,7 +183,10 @@ class SPMDJob:
                 "JobFailed": self._on_job_failed,
                 "Ping": lambda req: {"pong": True, "gen": self._gen},
             },
+            host=bind_host,
         )
+        advertise = local_ip() if self._multihost else "127.0.0.1"
+        driver_addr = f"{advertise}:{self._server.port}"
         coordinator = f"{self.hosts[0]}:{self._pick_coordinator_port()}"
         ctx = SPMDJobContext(
             self.job_name, self.world_size, self.hosts, self.num_procs_per_node
@@ -193,7 +204,7 @@ class SPMDJob:
                     ENV_JOB_NAME: self.job_name,
                     ENV_RANK: str(rank),
                     ENV_WORLD_SIZE: str(self.world_size),
-                    ENV_DRIVER_ADDR: self._server.address,
+                    ENV_DRIVER_ADDR: driver_addr,
                     ENV_COORDINATOR: coordinator,
                     ENV_PROCS_PER_NODE: str(self.num_procs_per_node),
                 }
@@ -362,6 +373,7 @@ def create_spmd_job(
     env: Optional[Dict[str, str]] = None,
     timeout: float = 30.0,
     hosts: Optional[List[str]] = None,
+    coordinator_port: Optional[int] = None,
 ) -> SPMDJob:
     """Create (but do not start) an SPMD job — the reference's
     ``create_mpi_job`` entry point (reference: mpi/__init__.py:36-91).
@@ -378,4 +390,5 @@ def create_spmd_job(
         env=env,
         timeout=timeout,
         hosts=hosts,
+        coordinator_port=coordinator_port,
     )

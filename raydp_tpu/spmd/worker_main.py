@@ -82,16 +82,25 @@ class SPMDWorker:
             node_ip=local_ip(),
             coordinator_address=os.environ[ENV_COORDINATOR],
         )
-        self.driver = RpcClient(os.environ[ENV_DRIVER_ADDR], DRIVER_SERVICE)
+        driver_addr = os.environ[ENV_DRIVER_ADDR]
+        self.driver = RpcClient(driver_addr, DRIVER_SERVICE)
         self._queue: "queue.Queue[Optional[dict]]" = queue.Queue()
         self._stop_event = threading.Event()
         self._last_func_id = 0
+        # Mirror the driver's multi-host binding: a remote driver must be
+        # able to reach this rank's service across the network.
+        multihost = not driver_addr.startswith("127.0.0.1")
         self._server = RpcServer(
             WORKER_SERVICE,
             {
                 "RunFunction": self._on_run_function,
                 "Stop": self._on_stop,
             },
+            host="0.0.0.0" if multihost else "127.0.0.1",
+        )
+        self._advertise = (
+            f"{self.ctx.node_ip}:{self._server.port}" if multihost
+            else self._server.address
         )
 
     def _on_run_function(self, req: dict) -> dict:
@@ -161,7 +170,7 @@ class SPMDWorker:
             "RegisterWorker",
             {
                 "rank": self.rank,
-                "address": self._server.address,
+                "address": self._advertise,
                 "host": self.ctx.node_ip,
                 "pid": os.getpid(),
             },

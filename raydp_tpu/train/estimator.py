@@ -397,6 +397,20 @@ class JAXEstimator:
     ) -> List[Dict[str, float]]:
         x, y = self._materialize_all(train_ds)
         n_true = len(x)
+        if n_true == 0:
+            # Duck-typed datasets without total_rows reach here empty
+            # (_use_scan can't pre-check); degrade like the stream path:
+            # record zero-sample epochs rather than crash in _pad_cycle.
+            logger.warning(
+                "scan-mode dataset is empty; recording empty epochs"
+            )
+            for epoch in range(epochs):
+                self._finish_epoch(
+                    epoch, time.perf_counter(), 0.0, 0, evaluate_ds
+                )
+            for cb in self.callbacks:
+                cb.on_train_end(self.history)
+            return self.history
         if self._state is None:
             self._init_state(x[:1])
         # Pad to steps × batch with batch divisible by dp; padded rows are

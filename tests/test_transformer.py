@@ -1,0 +1,171 @@
+"""Transformer family tests on the virtual 8-device mesh.
+
+Covers: forward shapes, megatron-style tp sharding of params via logical
+rules (real sharded train step on a dp×tp mesh), sequence-parallel
+attention variants inside the model, and loss decrease on a toy task.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from raydp_tpu.models.transformer import (
+    CausalLM,
+    SequenceClassifier,
+    TransformerEncoder,
+    param_shardings,
+    tiny_transformer,
+)
+
+
+def _ids(rng, cfg, batch=8, seq=16):
+    return rng.randint(0, cfg.vocab_size, size=(batch, seq)).astype(np.int32)
+
+
+def test_encoder_forward_shape():
+    cfg = tiny_transformer()
+    model = TransformerEncoder(cfg)
+    ids = _ids(np.random.RandomState(0), cfg)
+    params = model.init(jax.random.PRNGKey(0), ids)
+    out = model.apply(params, ids)
+    assert out.shape == (8, 16, cfg.d_model)
+    assert np.all(np.isfinite(np.asarray(out, dtype=np.float32)))
+
+
+def test_classifier_logits_float32():
+    cfg = tiny_transformer()
+    model = SequenceClassifier(cfg, num_classes=3)
+    ids = _ids(np.random.RandomState(1), cfg)
+    seg = np.zeros_like(ids)
+    params = model.init(jax.random.PRNGKey(0), ids, seg)
+    logits = model.apply(params, ids, seg)
+    assert logits.shape == (8, 3)
+    assert logits.dtype == jnp.float32
+
+
+def test_param_shardings_tp(eight_cpu_devices):
+    """QKV/MLP-up kernels shard over tp; out/MLP-down shard on the other
+    side; embeddings replicate."""
+    mesh = Mesh(
+        np.array(eight_cpu_devices[:8]).reshape(2, 4), ("dp", "tp")
+    )
+    cfg = tiny_transformer()
+    model = TransformerEncoder(cfg)
+    ids = _ids(np.random.RandomState(0), cfg)
+    _, shardings = param_shardings(model, mesh, ids)
+    p = shardings["params"]
+    blk = p["block_0"]
+    assert blk["attn"]["qkv"]["kernel"].spec == P(None, None, "tp", None)
+    assert blk["attn"]["out"]["kernel"].spec == P("tp", None, None)
+    assert blk["mlp_up"]["kernel"].spec == P(None, "tp")
+    assert blk["mlp_down"]["kernel"].spec == P("tp", None)
+    assert p["tok_embed"]["embedding"].spec == P(None, None)
+
+
+def test_sharded_train_step_dp_tp(eight_cpu_devices):
+    """One real sharded train step over dp=2 × tp=4: params land sharded,
+    grads flow, loss finite. XLA derives the tp psums from shardings."""
+    mesh = Mesh(
+        np.array(eight_cpu_devices[:8]).reshape(2, 4), ("dp", "tp")
+    )
+    cfg = tiny_transformer(dtype=jnp.float32)
+    model = SequenceClassifier(cfg, num_classes=2)
+    rng = np.random.RandomState(0)
+    ids = _ids(rng, cfg, batch=8, seq=16)
+    labels = rng.randint(0, 2, size=(8,))
+
+    import flax.linen as nn
+
+    _, shardings = param_shardings(model, mesh, ids, np.zeros_like(ids))
+    init_fn = jax.jit(
+        lambda: nn.unbox(
+            model.init(jax.random.PRNGKey(0), ids, np.zeros_like(ids))
+        ),
+        out_shardings=shardings,
+    )
+    params = init_fn()
+    # qkv kernel is actually distributed over the tp axis: each device
+    # holds a 1/4 slice of the heads dimension
+    qkv = params["params"]["encoder"]["block_0"]["attn"]["qkv"]["kernel"]
+    assert qkv.sharding.spec == P(None, None, "tp", None)
+    shard_shape = qkv.addressable_shards[0].data.shape
+    assert shard_shape[2] == qkv.shape[2] // 4
+
+    data_sharding = NamedSharding(mesh, P("dp"))
+    ids_d = jax.device_put(ids, data_sharding)
+    seg_d = jax.device_put(np.zeros_like(ids), data_sharding)
+    y_d = jax.device_put(labels, data_sharding)
+
+    def step(params, ids, seg, y):
+        def loss_fn(p):
+            logits = model.apply(p, ids, seg)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, y
+            ).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        return jax.tree.map(lambda p, g: p - 0.1 * g, params, grads), loss
+
+    # pin param shardings on the output so updates never drift to a
+    # compiler-chosen layout
+    step = jax.jit(step, out_shardings=(shardings, None))
+
+    params2, loss = step(params, ids_d, seg_d, y_d)
+    assert np.isfinite(float(loss))
+    # updated params keep their sharding (no silent full replication)
+    qkv2 = params2["params"]["encoder"]["block_0"]["attn"]["qkv"]["kernel"]
+    assert qkv2.sharding.spec == P(None, None, "tp", None)
+
+
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_sequence_parallel_attention_matches_dense(eight_cpu_devices, impl):
+    """ring/ulysses inside the model ≈ dense attention numerics."""
+    mesh = Mesh(np.array(eight_cpu_devices[:4]).reshape(1, 4), ("dp", "sp"))
+    cfg_dense = tiny_transformer(dtype=jnp.float32)
+    cfg_sp = tiny_transformer(
+        dtype=jnp.float32, attention_impl=impl, mesh=mesh
+    )
+    ids = _ids(np.random.RandomState(2), cfg_dense, batch=2, seq=32)
+
+    model_d = TransformerEncoder(cfg_dense)
+    model_s = TransformerEncoder(cfg_sp)
+    params = model_d.init(jax.random.PRNGKey(0), ids)
+
+    out_d = np.asarray(model_d.apply(params, ids), dtype=np.float32)
+    out_s = np.asarray(model_s.apply(params, ids), dtype=np.float32)
+    np.testing.assert_allclose(out_d, out_s, rtol=2e-4, atol=2e-4)
+
+
+def test_causal_lm_loss_decreases():
+    cfg = tiny_transformer(
+        vocab_size=64, d_model=128, n_layers=1, causal=True,
+        dtype=jnp.float32,
+    )
+    model = CausalLM(cfg)
+    rng = np.random.RandomState(3)
+    ids = rng.randint(0, 64, size=(4, 16)).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)
+
+    tx = optax.adam(1e-2)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state):
+        def loss_fn(p):
+            logits = model.apply(p, ids)[:, :-1]
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, ids[:, 1:]
+            ).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    losses = []
+    for _ in range(12):
+        params, opt_state, loss = step(params, opt_state)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.8, losses
