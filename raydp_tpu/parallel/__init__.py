@@ -6,6 +6,13 @@ from raydp_tpu.parallel.mesh import (
     logical_to_spec,
     named_sharding,
 )
+from raydp_tpu.parallel.pipeline import (
+    pipeline_bubble_fraction,
+    spmd_pipeline,
+    stack_stages,
+    stage_sharding,
+    unstack_stages,
+)
 
 __all__ = [
     "AXIS_ORDER",
@@ -14,4 +21,9 @@ __all__ = [
     "factor_devices",
     "logical_to_spec",
     "named_sharding",
+    "pipeline_bubble_fraction",
+    "spmd_pipeline",
+    "stack_stages",
+    "stage_sharding",
+    "unstack_stages",
 ]
