@@ -9,7 +9,24 @@ from raydp_tpu.models.transformer import (
     tiny_transformer,
 )
 
+from raydp_tpu.models.dlrm import (
+    DLRM,
+    DLRMConfig,
+    PackedDLRM,
+    ShardedEmbedding,
+    criteo_dlrm,
+    dlrm_shardings,
+    tiny_dlrm,
+)
+
 __all__ = [
+    "DLRM",
+    "DLRMConfig",
+    "PackedDLRM",
+    "ShardedEmbedding",
+    "criteo_dlrm",
+    "dlrm_shardings",
+    "tiny_dlrm",
     "MLP",
     "binary_classifier",
     "taxi_fare_regressor",

@@ -1,0 +1,142 @@
+"""DLRM tests: embedding-impl equivalence, tp-sharded tables, bags,
+end-to-end training on a dp×tp mesh."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from raydp_tpu.models.dlrm import (
+    DLRM,
+    PackedDLRM,
+    ShardedEmbedding,
+    dlrm_shardings,
+    tiny_dlrm,
+)
+from raydp_tpu.parallel import MeshSpec
+
+
+def _batch(cfg, b=16, seed=0, bag=None):
+    rng = np.random.default_rng(seed)
+    dense = rng.standard_normal((b, cfg.dense_features)).astype(np.float32)
+    shape = (b, cfg.n_tables) if bag is None else (b, cfg.n_tables, bag)
+    sparse = np.stack(
+        [
+            rng.integers(0, v, size=shape[:1] + shape[2:])
+            for v in cfg.vocab_sizes
+        ],
+        axis=1,
+    ).astype(np.int32)
+    return jnp.asarray(dense), jnp.asarray(sparse)
+
+
+def test_forward_shape_and_finite():
+    cfg = tiny_dlrm()
+    model = DLRM(cfg)
+    dense, sparse = _batch(cfg)
+    import flax.linen as nn
+
+    params = nn.unbox(model.init(jax.random.PRNGKey(0), dense, sparse))
+    out = model.apply(params, dense, sparse)
+    assert out.shape == (16,)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_onehot_matches_take():
+    """The MXU one-hot contraction and the gather must agree."""
+    table_kw = dict(vocab_size=50, embed_dim=8, dtype=jnp.float32)
+    ids = jnp.asarray([[3], [11], [49], [0]], dtype=jnp.int32)[:, 0]
+    e_take = ShardedEmbedding(impl="take", **table_kw)
+    params = e_take.init(jax.random.PRNGKey(1), ids)
+    out_take = e_take.apply(params, ids)
+    out_oh = ShardedEmbedding(impl="onehot", **table_kw).apply(params, ids)
+    np.testing.assert_allclose(
+        np.asarray(out_take), np.asarray(out_oh), atol=1e-6
+    )
+
+
+def test_multihot_bag_pooling():
+    table_kw = dict(vocab_size=30, embed_dim=4, dtype=jnp.float32)
+    bags = jnp.asarray([[1, 2, 3], [4, 4, 4]], dtype=jnp.int32)
+    import flax.linen as nn
+
+    e = ShardedEmbedding(pooling="sum", impl="take", **table_kw)
+    params = nn.unbox(e.init(jax.random.PRNGKey(0), bags))
+    table = params["params"]["table"]
+    out = e.apply(params, bags)
+    want0 = table[1] + table[2] + table[3]
+    np.testing.assert_allclose(np.asarray(out[0]), np.asarray(want0), atol=1e-6)
+
+    mean = ShardedEmbedding(pooling="mean", impl="onehot", **table_kw).apply(
+        params, bags
+    )
+    np.testing.assert_allclose(
+        np.asarray(mean[0]), np.asarray(want0) / 3, atol=1e-6
+    )
+
+
+def test_sharded_tables_on_tp_mesh(eight_cpu_devices):
+    """Vocab-sharded tables over tp produce the same logits as a single
+    replicated device, with the big table actually sharded."""
+    cfg = tiny_dlrm(dtype=jnp.float32)
+    model = DLRM(cfg)
+    dense, sparse = _batch(cfg, b=8, seed=2)
+    import flax.linen as nn
+
+    params = nn.unbox(model.init(jax.random.PRNGKey(0), dense, sparse))
+    want = model.apply(params, dense, sparse)
+
+    mesh = MeshSpec(dp=2, tp=4).build()
+    _, shardings = dlrm_shardings(model, mesh, dense, sparse)
+    params_sh = jax.device_put(params, shardings)
+    big = params_sh["params"]["emb_1"]["table"]
+    assert big.sharding.spec[0] == "tp", big.sharding.spec
+
+    dense_d = jax.device_put(dense, NamedSharding(mesh, P("dp")))
+    sparse_d = jax.device_put(sparse, NamedSharding(mesh, P("dp")))
+    got = jax.jit(model.apply)(params_sh, dense_d, sparse_d)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=1e-4, rtol=1e-4
+    )
+
+
+def test_packed_dlrm_trains(eight_cpu_devices):
+    """PackedDLRM + JAXEstimator: CTR loss decreases on synthetic data
+    (numeric assertion, not just runs-to-completion — SURVEY §4)."""
+    from raydp_tpu.train.estimator import JAXEstimator
+
+    cfg = tiny_dlrm(dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    n = 512
+    dense = rng.standard_normal((n, cfg.dense_features)).astype(np.float32)
+    sparse = np.stack(
+        [rng.integers(0, v, size=n) for v in cfg.vocab_sizes], axis=1
+    ).astype(np.float32)
+    # Label depends on dense[:,0] and whether the first id is even.
+    y = (
+        (dense[:, 0] + (sparse[:, 0] % 2) - 0.5) > 0
+    ).astype(np.float32)
+
+    import pandas as pd
+
+    cols = [f"d{i}" for i in range(cfg.dense_features)] + [
+        f"c{i}" for i in range(cfg.n_tables)
+    ]
+    df = pd.DataFrame(
+        np.concatenate([dense, sparse], axis=1), columns=cols
+    )
+    df["label"] = y
+
+    est = JAXEstimator(
+        model=PackedDLRM(cfg),
+        loss="bce",
+        num_epochs=8,
+        batch_size=64,
+        feature_columns=cols,
+        label_column="label",
+        mesh=MeshSpec(dp=2, tp=2),
+        seed=0,
+    )
+    est.fit_on_df(df)
+    losses = [h["train_loss"] for h in est.history]
+    assert losses[-1] < losses[0] * 0.9, losses
