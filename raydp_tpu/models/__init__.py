@@ -19,7 +19,20 @@ from raydp_tpu.models.dlrm import (
     tiny_dlrm,
 )
 
+from raydp_tpu.models.moe import (
+    MoEBlock,
+    MoEConfig,
+    MoELayer,
+    moe_aux_loss,
+    tiny_moe,
+)
+
 __all__ = [
+    "MoEBlock",
+    "MoEConfig",
+    "MoELayer",
+    "moe_aux_loss",
+    "tiny_moe",
     "DLRM",
     "DLRMConfig",
     "PackedDLRM",
