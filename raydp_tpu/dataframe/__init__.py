@@ -38,6 +38,19 @@ from raydp_tpu.dataframe.expr import (
     when,
     year,
 )
+from raydp_tpu.dataframe.expr import monotonically_increasing_id
+from raydp_tpu.dataframe.window import (
+    Window,
+    WindowSpec,
+    asc,
+    desc,
+    dense_rank,
+    lag,
+    lead,
+    rank,
+    row_number,
+    window_sum,
+)
 from raydp_tpu.dataframe.io import (
     from_arrow,
     from_items,
@@ -53,6 +66,9 @@ __all__ = [
     "year", "month", "dayofmonth", "hour", "minute", "second",
     "quarter", "weekofyear", "dayofweek",
     "sqrt", "exp", "log", "floor", "ceil", "lower", "upper", "length",
+    "monotonically_increasing_id",
+    "Window", "WindowSpec", "asc", "desc",
+    "row_number", "rank", "dense_rank", "lag", "lead", "window_sum",
     "from_arrow", "from_items", "from_pandas", "range",
     "read_csv", "read_parquet",
 ]

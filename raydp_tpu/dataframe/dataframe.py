@@ -45,6 +45,9 @@ class DataFrame:
         self._parts = parts
         self._executor = executor or _default_executor()
         self._pending = list(pending or [])
+        # Keys this frame is currently hash-partitioned on (co-located
+        # groups); lets chained window ops on one spec skip re-shuffles.
+        self._exchange_keys: Optional[tuple] = None
 
     # -- plan helpers ---------------------------------------------------
     def _with(self, fn: Callable[[pa.Table], pa.Table]) -> "DataFrame":
@@ -63,9 +66,63 @@ class DataFrame:
             return table
 
         parts = self._executor.map_partitions(self._parts, run)
-        return DataFrame(parts, self._executor)
+        out = DataFrame(parts, self._executor)
+        out._exchange_keys = self._exchange_keys  # rows did not move
+        return out
 
     # -- narrow ops -----------------------------------------------------
+    def _apply_expr_stage(
+        self,
+        exprs: List[E.Expr],
+        fn: Callable[[pa.Table], pa.Table],
+        keeps_keys: Optional[Callable[[tuple], bool]] = None,
+    ) -> "DataFrame":
+        """Run a projection stage with full expression semantics: window
+        expressions force a hash exchange on their partition keys (elided
+        when already partitioned on them), and partition-indexed
+        expressions (monotonically_increasing_id) bind the index.
+
+        ``keeps_keys(keys)`` says whether the stage preserves the key
+        columns (for exchange-elision on chained window ops)."""
+        from raydp_tpu.dataframe.window import find_window_exprs
+
+        wins = [w for e in exprs for w in find_window_exprs(e)]
+        keys: Optional[tuple] = None
+        base = self
+        if wins:
+            keys = tuple(wins[0].spec.partition_keys)
+            for w in wins[1:]:
+                if tuple(w.spec.partition_keys) != keys:
+                    raise ValueError(
+                        "all window functions in one projection must share "
+                        f"partition keys; got {list(keys)} and "
+                        f"{w.spec.partition_keys}"
+                    )
+            if self._exchange_keys != keys:
+                base = self._exchange_by_keys(list(keys))
+
+        if any(E.find_nodes(e, E.MonotonicId) for e in exprs):
+            df = base._flush()
+
+            def indexed(t: pa.Table, i: int) -> pa.Table:
+                E._EVAL_CTX.partition_index = i
+                try:
+                    return fn(t)
+                finally:
+                    E._EVAL_CTX.partition_index = None
+
+            parts = df._executor.map_partitions_indexed(df._parts, indexed)
+            out = DataFrame(parts, df._executor)
+            out._exchange_keys = df._exchange_keys
+        else:
+            out = base._with(fn)
+
+        if keys is not None:
+            out._exchange_keys = (
+                keys if keeps_keys is None or keeps_keys(keys) else None
+            )
+        return out
+
     def select(self, *columns: ColumnLike) -> "DataFrame":
         exprs = [_as_expr(c) for c in columns]
         names = [_col_name(c) for c in columns]
@@ -80,7 +137,15 @@ class DataFrame:
             arrays = [_as_array(e.evaluate(t), t.num_rows) for e in exprs]
             return pa.table(dict(zip(names, arrays)))
 
-        return self._with(fn)
+        # A projection keeps key co-location only if every key survives as
+        # a plain column reference under its own name.
+        plain = {
+            n for n, e in zip(names, exprs) if isinstance(e, E.Col)
+            and e.name == n
+        }
+        return self._apply_expr_stage(
+            exprs, fn, keeps_keys=lambda keys: set(keys) <= plain
+        )
 
     def withColumn(self, name: str, column: E.Expr) -> "DataFrame":
         e = _as_expr(column)
@@ -92,9 +157,117 @@ class DataFrame:
                 return t.set_column(idx, name, arr)
             return t.append_column(name, arr)
 
-        return self._with(fn)
+        # Adding a column keeps key co-location unless it overwrites a key.
+        return self._apply_expr_stage(
+            [e], fn, keeps_keys=lambda keys: name not in keys
+        )
 
     with_column = withColumn
+
+    def _exchange_by_keys(self, keys: List[str]) -> "DataFrame":
+        """Hash-exchange so rows with equal key values land on the same
+        partition (the shuffle behind window functions and distinct)."""
+        df = self._flush()
+        n_out = max(1, len(df._parts))
+        if n_out == 1:
+            df._exchange_keys = tuple(keys)  # trivially co-located
+            return df
+
+        def splitter(t: pa.Table) -> List[pa.Table]:
+            if t.num_rows == 0:
+                return [t] * n_out
+            bucket = _hash_bucket(t, keys, n_out)
+            return [t.filter(pa.array(bucket == i)) for i in range(n_out)]
+
+        parts = df._executor.exchange(df._parts, splitter, n_out)
+        out = DataFrame(parts, df._executor)
+        out._exchange_keys = tuple(keys)
+        return out
+
+    def distinct(self, subset: Optional[List[str]] = None) -> "DataFrame":
+        """Drop duplicate rows (Spark ``distinct``/``dropDuplicates``) —
+        wide: exchange on the subset, dedupe per partition."""
+        df = self._flush()
+        keys = subset or (df.columns if df._parts else [])
+        if not keys:
+            return df
+        exchanged = df._exchange_by_keys(list(keys))
+
+        def dedupe(t: pa.Table) -> pa.Table:
+            if t.num_rows == 0:
+                return t
+            pdf = t.to_pandas().drop_duplicates(
+                subset=subset if subset else None
+            )
+            return pa.Table.from_pandas(pdf, preserve_index=False,
+                                        schema=t.schema)
+
+        return exchanged._with(dedupe)._flush()
+
+    dropDuplicates = distinct
+
+    def explode(self, column: str, pos: Optional[str] = None) -> "DataFrame":
+        """Explode a list column into one row per element, other columns
+        repeated (Spark ``explode``; ``pos`` adds a position column for
+        ``posexplode`` semantics)."""
+
+        def _has_elements(v) -> bool:
+            if v is None:
+                return False
+            if isinstance(v, float) and np.isnan(v):
+                return False
+            try:
+                return len(v) > 0
+            except TypeError:
+                return False
+
+        def fn(t: pa.Table) -> pa.Table:
+            pdf = t.to_pandas()
+            # Spark explode/posexplode emits NO row for null/empty arrays.
+            pdf = pdf[pdf[column].map(_has_elements)]
+            if pos is not None:
+                pdf = pdf.assign(
+                    **{pos: pdf[column].map(lambda v: list(range(len(v))))}
+                )
+                pdf = pdf.explode([pos, column], ignore_index=True)
+            else:
+                pdf = pdf.explode(column, ignore_index=True)
+            return pa.Table.from_pandas(pdf, preserve_index=False)
+
+        return self._with(fn)
+
+    def posexplode(
+        self,
+        columns: List[str],
+        pos_name: str = "pos",
+        value_name: str = "col",
+        keep: Optional[List[str]] = None,
+    ) -> "DataFrame":
+        """Melt ``columns`` into ``(pos, value)`` rows — the reference's
+        DLRM categorical-frequency pattern
+        ``select(posexplode(array(*cols)))`` (examples/pytorch_dlrm.ipynb).
+        ``keep`` optionally carries extra columns through."""
+        carry = list(keep or [])
+
+        def fn(t: pa.Table) -> pa.Table:
+            n = t.num_rows
+            vals = [t.column(c) for c in columns]
+            target = _common_type(vals)
+            arrays = {
+                pos_name: pa.array(
+                    np.repeat(np.arange(len(columns), dtype=np.int64), n)
+                ),
+                value_name: pa.concat_arrays(
+                    [v.combine_chunks().cast(target) for v in vals]
+                ),
+            }
+            for c in carry:
+                arrays[c] = pa.chunked_array(
+                    [t.column(c).combine_chunks()] * len(columns)
+                ).combine_chunks()
+            return pa.table(arrays)
+
+        return self._with(fn)
 
     def withColumnRenamed(self, old: str, new: str) -> "DataFrame":
         def fn(t: pa.Table) -> pa.Table:
@@ -571,6 +744,21 @@ def _table_fingerprint(t: pa.Table) -> int:
         last = str(t.column(0)[t.num_rows - 1].as_py())
         h = zlib.crc32(f"{h}|{first}|{last}".encode()) & 0x7FFFFFFF
     return h
+
+
+def _common_type(cols) -> pa.DataType:
+    """Promotion for posexplode'd columns: equal types pass through,
+    mixed numerics widen, anything else goes to string."""
+    types = {c.type for c in cols}
+    if len(types) == 1:
+        return next(iter(types))
+    if all(
+        pa.types.is_integer(t) or pa.types.is_floating(t) for t in types
+    ):
+        if any(pa.types.is_floating(t) for t in types):
+            return pa.float64()
+        return pa.int64()
+    return pa.string()
 
 
 def _hash_bucket(t: pa.Table, keys: List[str], n: int) -> np.ndarray:

@@ -30,6 +30,13 @@ class Executor:
     def map_partitions(self, parts: List[Any], fn: StageFn) -> List[Any]:
         raise NotImplementedError
 
+    def map_partitions_indexed(
+        self, parts: List[Any], fn: Callable[[pa.Table, int], pa.Table]
+    ) -> List[Any]:
+        """Like map_partitions, but ``fn`` also receives the partition
+        index (for partition-indexed ops like monotonically_increasing_id)."""
+        raise NotImplementedError
+
     def exchange(
         self,
         parts: List[Any],
@@ -79,6 +86,9 @@ class LocalExecutor(Executor):
 
     def map_partitions(self, parts, fn):
         return list(self._pool.map(fn, parts))
+
+    def map_partitions_indexed(self, parts, fn):
+        return list(self._pool.map(fn, parts, range(len(parts))))
 
     def exchange(self, parts, splitter, n_out, combine=None):
         chunked = list(self._pool.map(splitter, parts))
@@ -134,6 +144,18 @@ class ClusterExecutor(Executor):
 
         futures = [
             self.cluster.submit_async(task, ref, worker_id=self._worker_for(i))
+            for i, ref in enumerate(parts)
+        ]
+        return [f.result() for f in futures]
+
+    def map_partitions_indexed(self, parts, fn):
+        def task(ctx, ref, index):
+            table = ctx.get_table(ref)
+            return ctx.put_table(fn(table, index))
+
+        futures = [
+            self.cluster.submit_async(task, ref, i,
+                                      worker_id=self._worker_for(i))
             for i, ref in enumerate(parts)
         ]
         return [f.result() for f in futures]

@@ -441,3 +441,66 @@ class CaseWhen(Expr):
 
 def _colify(e) -> Expr:
     return Col(e) if isinstance(e, str) else _wrap(e)
+
+
+# -- partition-indexed expressions ----------------------------------------
+import threading
+
+_EVAL_CTX = threading.local()
+
+
+class MonotonicId(Expr):
+    """Spark-compatible ``monotonically_increasing_id()``: unique,
+    monotonically increasing within each partition —
+    ``partition_index << 33 | row_position`` (no global barrier, matching
+    Spark's contract of monotonic-but-not-consecutive ids; used by the
+    DLRM preprocessing's ``rand_ordinal``, examples/pytorch_dlrm.ipynb).
+
+    Needs the physical partition index, which ``DataFrame.withColumn``
+    binds around evaluation (thread-local; each partition stage runs on
+    one thread).
+    """
+
+    name = "monotonically_increasing_id"
+
+    def evaluate(self, table: pa.Table):
+        pidx = getattr(_EVAL_CTX, "partition_index", None)
+        if pidx is None:
+            raise RuntimeError(
+                "monotonically_increasing_id() is only valid inside "
+                "DataFrame.withColumn/select"
+            )
+        start = pidx << 33
+        return pa.array(
+            np.arange(start, start + table.num_rows, dtype=np.int64),
+            type=pa.int64(),
+        )
+
+
+def monotonically_increasing_id() -> MonotonicId:
+    return MonotonicId()
+
+
+def find_nodes(expr: Expr, cls) -> List:
+    """All nodes of type ``cls`` in an expression tree (walks the known
+    child attributes of the Expr classes)."""
+    found, seen = [], set()
+
+    def walk(e):
+        if id(e) in seen or not isinstance(e, Expr):
+            return
+        seen.add(id(e))
+        if isinstance(e, cls):
+            found.append(e)
+        for attr in ("child", "left", "right", "otherwise_"):
+            sub = getattr(e, attr, None)
+            if isinstance(sub, Expr):
+                walk(sub)
+        for sub in getattr(e, "args", []) or []:
+            walk(sub)
+        for cond, val in getattr(e, "branches", []) or []:
+            walk(cond)
+            walk(val)
+
+    walk(expr)
+    return found

@@ -1,0 +1,204 @@
+"""Window functions: ``Window.partitionBy(...).orderBy(...)`` +
+``row_number()/rank()/lag()/...`` — the Spark window surface the
+reference's DLRM preprocessing depends on (reference:
+examples/pytorch_dlrm.ipynb ``assign_id_with_window``:
+``Window.partitionBy('column_id').orderBy(desc('count'))`` with
+``row_number().over(w) - 1``).
+
+Execution model: a window expression is a *wide* op — the DataFrame
+hash-exchanges rows by the partition keys first so each physical
+partition holds whole window groups, then every group computes locally
+(pandas kernels) with results aligned back to input row order.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+import pyarrow as pa
+
+from raydp_tpu.dataframe.expr import Col, Expr, _wrap
+
+__all__ = [
+    "Window",
+    "WindowSpec",
+    "WindowExpr",
+    "desc",
+    "asc",
+    "row_number",
+    "rank",
+    "dense_rank",
+    "lag",
+    "lead",
+    "cume_count",
+    "window_sum",
+    "find_window_exprs",
+]
+
+
+class _SortKey:
+    def __init__(self, column: str, ascending: bool):
+        self.column = column
+        self.ascending = ascending
+
+
+def desc(column: str) -> _SortKey:
+    return _SortKey(column, False)
+
+
+def asc(column: str) -> _SortKey:
+    return _SortKey(column, True)
+
+
+class WindowSpec:
+    def __init__(
+        self,
+        partition_keys: Sequence[str],
+        order_keys: Sequence[_SortKey] = (),
+    ):
+        if not partition_keys:
+            raise ValueError("window needs at least one partition key")
+        self.partition_keys = list(partition_keys)
+        self.order_keys = list(order_keys)
+
+    def orderBy(self, *cols: Union[str, _SortKey]) -> "WindowSpec":
+        keys = [
+            c if isinstance(c, _SortKey) else _SortKey(c, True) for c in cols
+        ]
+        return WindowSpec(self.partition_keys, keys)
+
+    order_by = orderBy
+
+
+class Window:
+    """Entry point matching pyspark.sql.Window."""
+
+    @staticmethod
+    def partitionBy(*keys: str) -> WindowSpec:
+        return WindowSpec(list(keys))
+
+    partition_by = partitionBy
+
+
+class WindowFunction:
+    """A window function awaiting ``.over(window_spec)``."""
+
+    def __init__(self, kind: str, column: Optional[str] = None, offset: int = 1,
+                 default=None):
+        self.kind = kind
+        self.column = column
+        self.offset = offset
+        self.default = default
+
+    def over(self, spec: WindowSpec) -> "WindowExpr":
+        return WindowExpr(self, spec)
+
+
+def row_number() -> WindowFunction:
+    return WindowFunction("row_number")
+
+
+def rank() -> WindowFunction:
+    return WindowFunction("rank")
+
+
+def dense_rank() -> WindowFunction:
+    return WindowFunction("dense_rank")
+
+
+def lag(column: str, offset: int = 1, default=None) -> WindowFunction:
+    return WindowFunction("lag", column, offset, default)
+
+
+def lead(column: str, offset: int = 1, default=None) -> WindowFunction:
+    return WindowFunction("lead", column, -offset, default)
+
+
+def cume_count() -> WindowFunction:
+    """Running count within the window frame (1-based, like row_number
+    but named for the count-over-window idiom)."""
+    return WindowFunction("row_number")
+
+
+def window_sum(column: str) -> WindowFunction:
+    """Sum of ``column`` over the whole window partition."""
+    return WindowFunction("sum", column)
+
+
+class WindowExpr(Expr):
+    """Expr node evaluated on a table that holds whole window groups.
+
+    ``DataFrame.withColumn`` detects these (``find_window_exprs``) and
+    hash-exchanges on the partition keys before evaluation.
+    """
+
+    def __init__(self, fn: WindowFunction, spec: WindowSpec):
+        self.fn = fn
+        self.spec = spec
+        self.name = fn.kind
+
+    def evaluate(self, table: pa.Table):
+        import pandas as pd
+
+        keys = self.spec.partition_keys
+        order = self.spec.order_keys
+        needed = set(keys) | {k.column for k in order}
+        if self.fn.column:
+            needed.add(self.fn.column)
+        missing = needed - set(table.column_names)
+        if missing:
+            raise KeyError(f"window columns {sorted(missing)} not in table")
+        df = table.select(sorted(needed)).to_pandas()
+        if df.empty:
+            return pa.array([], type=pa.int64())
+
+        if order:
+            ordered = df.sort_values(
+                [k.column for k in order],
+                ascending=[k.ascending for k in order],
+                kind="stable",
+            )
+        else:
+            ordered = df
+        grouped = ordered.groupby(keys, sort=False, dropna=False)
+
+        kind = self.fn.kind
+        if kind == "row_number":
+            out = grouped.cumcount() + 1
+        elif kind in ("rank", "dense_rank"):
+            if len(order) != 1:
+                raise ValueError(f"{kind} needs exactly one orderBy column")
+            k = order[0]
+            out = grouped[k.column].rank(
+                method="min" if kind == "rank" else "dense",
+                ascending=k.ascending,
+            ).astype(np.int64)
+        elif kind in ("lag", "lead"):
+            out = grouped[self.fn.column].shift(self.fn.offset)
+            if self.fn.default is not None:
+                # Spark's default fills only out-of-window positions, never
+                # genuine nulls shifted in from real rows — mask on row
+                # position within the group, not on NaN.
+                pos = grouped.cumcount()
+                n = self.fn.offset
+                if n >= 0:
+                    hole = pos < n
+                else:
+                    size = grouped[self.fn.column].transform("size")
+                    hole = pos >= size + n
+                out = out.mask(hole, self.fn.default)
+        elif kind == "sum":
+            out = grouped[self.fn.column].transform("sum")
+        else:
+            raise ValueError(f"unknown window function {kind!r}")
+
+        # sort_values kept the original index; realign to input row order.
+        out = out.reindex(df.index) if not out.index.equals(df.index) else out
+        return pa.Array.from_pandas(out)
+
+
+def find_window_exprs(expr: Expr) -> List[WindowExpr]:
+    """All WindowExpr nodes in an expression tree."""
+    from raydp_tpu.dataframe.expr import find_nodes
+
+    return find_nodes(expr, WindowExpr)
