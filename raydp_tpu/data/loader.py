@@ -22,6 +22,7 @@ from typing import Dict, Iterator, List, Optional, Tuple
 import numpy as np
 
 from raydp_tpu.native import lib as native
+from raydp_tpu.utils.profiling import metrics
 
 
 class JaxShardLoader:
@@ -98,6 +99,9 @@ class JaxShardLoader:
             rng = np.random.default_rng(self.seed + epoch * 1009 + self._rank)
             rng.shuffle(order)
         n_batches = len(self)
+        # Hoisted out of the hot loop: meter() takes the registry lock.
+        rows_meter = metrics.meter("ingest/rows")
+        bytes_meter = metrics.meter("ingest/bytes")
         # The native gather stages in float32/int32 only; any other
         # requested dtype must NOT round-trip through float32 (precision
         # loss for float64 / int64 ids) — use the exact numpy path instead.
@@ -121,6 +125,9 @@ class JaxShardLoader:
             y = None
             if labels is not None:
                 y = labels[idx].astype(self.label_dtype, copy=False)
+            metrics.counter_add("ingest/batches")
+            rows_meter.add(len(idx))
+            bytes_meter.add(x.nbytes + (y.nbytes if y is not None else 0))
             yield x, y
 
     def _epoch_iter(self, epoch: int):

@@ -121,6 +121,16 @@ class DataFrame:
             out._exchange_keys = (
                 keys if keeps_keys is None or keeps_keys(keys) else None
             )
+        elif self._exchange_keys is not None and keeps_keys is not None:
+            # No window in this stage: existing co-location survives iff
+            # the stage preserves the key columns (row subsets, plain
+            # column adds) — lets window → narrow op → window chains
+            # still elide the second shuffle.
+            out._exchange_keys = (
+                self._exchange_keys
+                if keeps_keys(self._exchange_keys)
+                else None
+            )
         return out
 
     def select(self, *columns: ColumnLike) -> "DataFrame":
@@ -284,7 +294,11 @@ class DataFrame:
                 mask = mask.combine_chunks()
             return t.filter(mask)
 
-        return self._with(fn)
+        # Window predicates (e.g. the row_number()==1 dedup idiom) need
+        # the exchange too; a row subset keeps key co-location intact.
+        return self._apply_expr_stage(
+            [condition], fn, keeps_keys=lambda keys: True
+        )
 
     where = filter
 

@@ -172,6 +172,8 @@ class WindowExpr(Expr):
             out = grouped[k.column].rank(
                 method="min" if kind == "rank" else "dense",
                 ascending=k.ascending,
+                # Spark ranks nulls first ascending / last descending.
+                na_option="top" if k.ascending else "bottom",
             ).astype(np.int64)
         elif kind in ("lag", "lead"):
             out = grouped[self.fn.column].shift(self.fn.offset)
@@ -188,7 +190,13 @@ class WindowExpr(Expr):
                     hole = pos >= size + n
                 out = out.mask(hole, self.fn.default)
         elif kind == "sum":
-            out = grouped[self.fn.column].transform("sum")
+            # Spark frame semantics: with orderBy the default frame is
+            # unboundedPreceding..currentRow (running sum); without it,
+            # the whole partition.
+            if order:
+                out = grouped[self.fn.column].cumsum()
+            else:
+                out = grouped[self.fn.column].transform("sum")
         else:
             raise ValueError(f"unknown window function {kind!r}")
 

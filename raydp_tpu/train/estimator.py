@@ -222,7 +222,12 @@ class JAXEstimator:
     ) -> Dict[str, float]:
         """Per-epoch tail shared by stream and scan paths: metrics dict,
         optional eval, callbacks, checkpoint."""
+        from raydp_tpu.utils.profiling import metrics as _m
+
         dt = time.perf_counter() - t0
+        _m.counter_add("train/epochs")
+        _m.meter("train/samples").add(n_samples)
+        _m.timer("train/epoch").observe(dt)
         metrics: Dict[str, float] = {
             "epoch": epoch,
             "train_loss": train_loss,

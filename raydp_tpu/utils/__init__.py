@@ -1,5 +1,13 @@
 from raydp_tpu.utils.memory import format_memory_size, parse_memory_size
 from raydp_tpu.utils.net import find_free_port, local_ip
+from raydp_tpu.utils.profiling import (
+    MetricsRegistry,
+    StepTimer,
+    ThroughputMeter,
+    annotate,
+    metrics,
+    trace,
+)
 from raydp_tpu.utils.sharding import (
     BlockSlice,
     assignment_sample_counts,
@@ -16,4 +24,10 @@ __all__ = [
     "divide_blocks",
     "assignment_sample_counts",
     "split_sizes",
+    "MetricsRegistry",
+    "StepTimer",
+    "ThroughputMeter",
+    "annotate",
+    "metrics",
+    "trace",
 ]

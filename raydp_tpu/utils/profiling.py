@@ -1,0 +1,173 @@
+"""Profiling & metrics: step timers, throughput counters, XLA traces.
+
+New subsystem relative to the reference (SURVEY §5.1: tracing/profiling
+is *absent* there — only an ad-hoc ``_timed`` contextmanager in the DLRM
+notebook). Here it is first-class because the north-star metrics
+(samples/sec/chip, ingest GB/s) need measurement built into the
+framework:
+
+* :class:`MetricsRegistry` — process-wide named counters + timers;
+  ingest and training both report here; ``snapshot()`` for dashboards.
+* :class:`StepTimer` — rolling per-step wall times with percentiles
+  (compile steps show up as outliers; ``p50`` is the steady state).
+* :func:`trace` — ``jax.profiler`` trace context writing a TensorBoard-
+  loadable profile (XLA ops, HBM, ICI collectives on real TPUs).
+* :func:`annotate` — named trace region so host-side stages (gather,
+  device_put) line up with device timelines.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional
+
+__all__ = [
+    "MetricsRegistry",
+    "StepTimer",
+    "ThroughputMeter",
+    "metrics",
+    "trace",
+    "annotate",
+]
+
+
+class StepTimer:
+    """Rolling window of step durations; cheap (deque + lock-free append
+    under the GIL)."""
+
+    def __init__(self, window: int = 1024):
+        self.window = window
+        self._times: "deque[float]" = deque(maxlen=window)
+        self._total = 0.0
+        self._count = 0
+
+    def observe(self, seconds: float) -> None:
+        self._times.append(seconds)
+        self._total += seconds
+        self._count += 1
+
+    @contextlib.contextmanager
+    def time(self) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(time.perf_counter() - t0)
+
+    def percentile(self, q: float) -> float:
+        if not self._times:
+            return 0.0
+        xs = sorted(self._times)
+        i = min(len(xs) - 1, int(q / 100.0 * len(xs)))
+        return xs[i]
+
+    def summary(self) -> Dict[str, float]:
+        return {
+            "count": float(self._count),
+            "total_s": self._total,
+            "mean_s": self._total / max(1, self._count),
+            "p50_s": self.percentile(50),
+            "p90_s": self.percentile(90),
+            "p99_s": self.percentile(99),
+        }
+
+
+class ThroughputMeter:
+    """Counts units (rows, bytes) against wall time since first record."""
+
+    def __init__(self):
+        self._units = 0.0
+        self._start: Optional[float] = None
+        self._last: Optional[float] = None
+
+    def add(self, units: float) -> None:
+        now = time.perf_counter()
+        if self._start is None:
+            self._start = now
+        self._last = now
+        self._units += units
+
+    @property
+    def total(self) -> float:
+        return self._units
+
+    def rate(self) -> float:
+        if self._start is None or self._last is None or self._last <= self._start:
+            return 0.0
+        return self._units / (self._last - self._start)
+
+    def summary(self) -> Dict[str, float]:
+        return {"total": self._units, "per_sec": self.rate()}
+
+
+@dataclass
+class MetricsRegistry:
+    """Named counters/timers/meters; one process-wide instance at
+    :data:`metrics`."""
+
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+    _counters: Dict[str, float] = field(default_factory=dict)
+    _timers: Dict[str, StepTimer] = field(default_factory=dict)
+    _meters: Dict[str, ThroughputMeter] = field(default_factory=dict)
+
+    def counter_add(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0.0) + value
+
+    def timer(self, name: str) -> StepTimer:
+        with self._lock:
+            if name not in self._timers:
+                self._timers[name] = StepTimer()
+            return self._timers[name]
+
+    def meter(self, name: str) -> ThroughputMeter:
+        with self._lock:
+            if name not in self._meters:
+                self._meters[name] = ThroughputMeter()
+            return self._meters[name]
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            out: Dict[str, Dict[str, float]] = {
+                "counters": dict(self._counters)
+            }
+            for name, t in self._timers.items():
+                out[f"timer/{name}"] = t.summary()
+            for name, m in self._meters.items():
+                out[f"meter/{name}"] = m.summary()
+            return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._timers.clear()
+            self._meters.clear()
+
+
+metrics = MetricsRegistry()
+
+
+@contextlib.contextmanager
+def trace(log_dir: str) -> Iterator[None]:
+    """Capture a ``jax.profiler`` trace (TensorBoard ``profile`` plugin
+    format: XLA ops, fusion names, HBM/ICI activity on TPU)."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named region on the host timeline (shows up alongside device ops
+    in the captured trace)."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield

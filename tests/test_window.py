@@ -204,6 +204,60 @@ def test_chained_windows_exchange_once():
     assert a.r.tolist() == list(range(1, 9))
 
 
+def test_window_in_filter_dedup_idiom():
+    """The Spark dedup pattern filter(row_number().over(w) == 1) must
+    exchange groups first (regression: silently kept one row per
+    physical partition per group)."""
+    pdf = pd.DataFrame({"g": ["a"] * 6, "v": [6, 5, 4, 3, 2, 1]})
+    df = rdf.from_pandas(pdf, num_partitions=3)
+    w = Window.partitionBy("g").orderBy(desc("v"))
+    out = df.filter(row_number().over(w) == 1).to_pandas()
+    assert len(out) == 1 and out.v.iloc[0] == 6
+
+
+def test_key_overwrite_clears_colocation():
+    """Overwriting or renaming a window key must clear the cached
+    exchange keys so the next window op re-shuffles."""
+    pdf = pd.DataFrame({"g": ["a", "b"] * 8, "v": list(range(16))})
+    df = rdf.from_pandas(pdf, num_partitions=4)
+    step1 = df.withColumn(
+        "r", row_number().over(Window.partitionBy("g").orderBy("v"))
+    )
+    assert step1._exchange_keys == ("g",)
+    assert step1.withColumn("g", col("v") % 2)._exchange_keys is None
+    assert step1.withColumnRenamed("g", "h")._exchange_keys is None
+    # filter keeps co-location (row subset)
+    assert step1.filter(col("v") > 3)._exchange_keys == ("g",)
+    out = (
+        step1.withColumn("g", col("v") % 2)
+        .withColumn("tot", window_sum("v").over(Window.partitionBy("g")))
+        .to_pandas()
+    )
+    want = out.groupby("g").v.transform("sum")
+    assert (out.tot == want).all()
+
+
+def test_rank_with_nulls():
+    pdf = pd.DataFrame({"g": ["a"] * 4, "v": [3.0, None, 1.0, 2.0]})
+    df = rdf.from_pandas(pdf, num_partitions=2)
+    w = Window.partitionBy("g").orderBy("v")
+    out = df.withColumn("r", rank().over(w)).to_pandas()
+    got = dict(zip(out.v.fillna(-1), out.r))
+    # Spark: nulls first ascending → null ranks 1, then 1.0→2, 2.0→3, 3.0→4
+    assert got[-1] == 1 and got[1.0] == 2 and got[2.0] == 3 and got[3.0] == 4
+
+
+def test_window_sum_running_with_orderby():
+    pdf = pd.DataFrame({"g": ["a"] * 3 + ["b"], "t": [1, 2, 3, 1],
+                        "v": [1.0, 2.0, 3.0, 5.0]})
+    df = rdf.from_pandas(pdf, num_partitions=2)
+    w = Window.partitionBy("g").orderBy("t")
+    out = df.withColumn("run", window_sum("v").over(w)).to_pandas()
+    a = out[out.g == "a"].sort_values("t")
+    assert a.run.tolist() == [1.0, 3.0, 6.0]       # running sum
+    assert out[out.g == "b"].run.tolist() == [5.0]
+
+
 @pytest.fixture(scope="module")
 def session():
     import raydp_tpu
