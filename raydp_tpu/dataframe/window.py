@@ -153,11 +153,20 @@ class WindowExpr(Expr):
             return pa.array([], type=pa.int64())
 
         if order:
-            ordered = df.sort_values(
-                [k.column for k in order],
-                ascending=[k.ascending for k in order],
-                kind="stable",
-            )
+            # Spark null ordering: nulls FIRST on ascending keys, LAST on
+            # descending — per key. pandas has one global na_position, so
+            # interleave an is-null indicator before each key (True sorts
+            # after False ascending; direction chosen per key).
+            tmp = df
+            sort_cols, sort_asc = [], []
+            for j, k in enumerate(order):
+                nullcol = f"__raydp_null_{j}"
+                tmp = tmp.assign(**{nullcol: tmp[k.column].isna()})
+                sort_cols += [nullcol, k.column]
+                sort_asc += [not k.ascending, k.ascending]
+            ordered = tmp.sort_values(
+                sort_cols, ascending=sort_asc, kind="stable"
+            )[df.columns]
         else:
             ordered = df
         grouped = ordered.groupby(keys, sort=False, dropna=False)
@@ -191,10 +200,15 @@ class WindowExpr(Expr):
                 out = out.mask(hole, self.fn.default)
         elif kind == "sum":
             # Spark frame semantics: with orderBy the default frame is
-            # unboundedPreceding..currentRow (running sum); without it,
-            # the whole partition.
+            # RANGE unboundedPreceding..currentRow — a running sum where
+            # order-key ties (peer rows) all get the full peer total;
+            # without orderBy, the whole partition.
             if order:
-                out = grouped[self.fn.column].cumsum()
+                csum = grouped[self.fn.column].cumsum()
+                peer_cols = [ordered[c] for c in keys] + [
+                    ordered[k.column] for k in order
+                ]
+                out = csum.groupby(peer_cols, dropna=False).transform("max")
             else:
                 out = grouped[self.fn.column].transform("sum")
         else:

@@ -283,19 +283,38 @@ class SPMDJob:
 
     # -------------------------------------------------------------------- run
 
-    def run(self, fn: Callable[..., Any], timeout: Optional[float] = None) -> List[Any]:
-        """Ship ``fn(worker_context)`` to every rank; return rank-ordered
-        results (reference: MPIJob.run, mpi/mpi_job.py:321-335)."""
+    def run(
+        self,
+        fn: Callable[..., Any],
+        timeout: Optional[float] = None,
+        per_rank_args: Optional[List[tuple]] = None,
+    ) -> List[Any]:
+        """Ship ``fn(worker_context, *args)`` to every rank; return
+        rank-ordered results (reference: MPIJob.run, mpi/mpi_job.py:321-335).
+
+        ``per_rank_args`` scatters: rank ``r`` receives only
+        ``per_rank_args[r]`` — large per-rank payloads (data shards) are
+        serialized once per rank, not world× to every rank."""
         if not self._started:
             raise SPMDJobError("job not started")
         if self._failed:
             raise SPMDJobError(f"job {self.job_name} failed: {self._failed}")
+        if per_rank_args is not None and len(per_rank_args) != self.world_size:
+            raise ValueError(
+                f"per_rank_args has {len(per_rank_args)} entries for "
+                f"world_size {self.world_size}"
+            )
         with self._lock:
             self._func_id += 1
             results = _FuncResults(self._func_id, self.world_size)
             self._inflight = results
-            payload = {"func_id": self._func_id, "fn": cloudpickle.dumps(fn)}
+            fn_blob = cloudpickle.dumps(fn)
             for rank, stub in self._stubs.items():
+                payload = {"func_id": self._func_id, "fn": fn_blob}
+                if per_rank_args is not None:
+                    payload["args"] = cloudpickle.dumps(
+                        tuple(per_rank_args[rank])
+                    )
                 stub.call("RunFunction", payload, timeout=10.0)
             if not results.done.wait(timeout or max(self.timeout, 60.0)):
                 raise SPMDJobError(

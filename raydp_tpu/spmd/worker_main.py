@@ -125,7 +125,12 @@ class SPMDWorker:
             value, error = None, None
             try:
                 fn = cloudpickle.loads(item["fn"])
-                value = fn(self.ctx)
+                args = (
+                    cloudpickle.loads(item["args"])
+                    if item.get("args") is not None
+                    else ()
+                )
+                value = fn(self.ctx, *args)
             except Exception:
                 error = traceback.format_exc()
             reply = self.driver.try_call(

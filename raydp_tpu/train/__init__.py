@@ -1,8 +1,10 @@
 from raydp_tpu.train.estimator import JAXEstimator, TrainingCallback
 from raydp_tpu.train.losses import LOSSES, METRICS, resolve_loss, resolve_metric
+from raydp_tpu.train.torch_estimator import TorchEstimator
 
 __all__ = [
     "JAXEstimator",
+    "TorchEstimator",
     "TrainingCallback",
     "LOSSES",
     "METRICS",

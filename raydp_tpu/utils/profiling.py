@@ -35,19 +35,23 @@ __all__ = [
 
 
 class StepTimer:
-    """Rolling window of step durations; cheap (deque + lock-free append
-    under the GIL)."""
+    """Rolling window of step durations. A per-timer lock covers the
+    deque: observe() runs per step (not per row) so the cost is noise,
+    and snapshot() from a monitoring thread must not race a mutating
+    append (``sorted(deque)`` raises if mutated mid-iteration)."""
 
     def __init__(self, window: int = 1024):
         self.window = window
         self._times: "deque[float]" = deque(maxlen=window)
         self._total = 0.0
         self._count = 0
+        self._mu = threading.Lock()
 
     def observe(self, seconds: float) -> None:
-        self._times.append(seconds)
-        self._total += seconds
-        self._count += 1
+        with self._mu:
+            self._times.append(seconds)
+            self._total += seconds
+            self._count += 1
 
     @contextlib.contextmanager
     def time(self) -> Iterator[None]:
@@ -58,20 +62,30 @@ class StepTimer:
             self.observe(time.perf_counter() - t0)
 
     def percentile(self, q: float) -> float:
-        if not self._times:
+        with self._mu:
+            xs = sorted(self._times)
+        if not xs:
             return 0.0
-        xs = sorted(self._times)
         i = min(len(xs) - 1, int(q / 100.0 * len(xs)))
         return xs[i]
 
     def summary(self) -> Dict[str, float]:
+        with self._mu:
+            xs = sorted(self._times)
+            total, count = self._total, self._count
+
+        def pct(q: float) -> float:
+            if not xs:
+                return 0.0
+            return xs[min(len(xs) - 1, int(q / 100.0 * len(xs)))]
+
         return {
-            "count": float(self._count),
-            "total_s": self._total,
-            "mean_s": self._total / max(1, self._count),
-            "p50_s": self.percentile(50),
-            "p90_s": self.percentile(90),
-            "p99_s": self.percentile(99),
+            "count": float(count),
+            "total_s": total,
+            "mean_s": total / max(1, count),
+            "p50_s": pct(50),
+            "p90_s": pct(90),
+            "p99_s": pct(99),
         }
 
 

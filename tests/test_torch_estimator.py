@@ -1,0 +1,222 @@
+"""TorchEstimator compat tests — the reference's estimator surface on our
+data plane (reference tests: test_torch.py:28-80 linear regression runs +
+loss decreases; here with numeric assertions)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from raydp_tpu.train.torch_estimator import TorchEstimator  # noqa: E402
+
+
+def _linear_df(n=512, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.random((n, 2)).astype(np.float32)
+    y = (2 * x[:, 0] + 3 * x[:, 1] + 0.05 * rng.standard_normal(n)).astype(
+        np.float32
+    )
+    df = pd.DataFrame(x, columns=["a", "b"])
+    df["y"] = y
+    return df
+
+
+class TwoColModel(torch.nn.Module):
+    """Reference-style model: one tensor arg per feature column
+    (reference: examples/pytorch_nyctaxi.py NYC_Model forward)."""
+
+    def __init__(self):
+        super().__init__()
+        self.fc = torch.nn.Linear(2, 1)
+
+    def forward(self, a, b):
+        return self.fc(torch.cat([a, b], dim=1))
+
+
+def test_fit_on_df_instance_forms():
+    """Model/optimizer/loss as instances (reference config style #1)."""
+    model = TwoColModel()
+    est = TorchEstimator(
+        model=model,
+        optimizer=torch.optim.Adam(model.parameters(), lr=5e-2),
+        loss=torch.nn.MSELoss(),
+        feature_columns=["a", "b"],
+        label_column="y",
+        batch_size=64,
+        num_epochs=10,
+    )
+    history = est.fit_on_df(_linear_df())
+    assert history[-1]["train_loss"] < history[0]["train_loss"] * 0.5
+
+
+def test_fit_creator_forms_and_scheduler():
+    """Creator functions for everything + lr scheduler (style #2;
+    reference: torch/estimator.py:152-195)."""
+
+    def model_creator(config):
+        return torch.nn.Sequential(
+            torch.nn.Linear(2, 16), torch.nn.ReLU(), torch.nn.Linear(16, 1)
+        )
+
+    def optimizer_creator(model, config):
+        return torch.optim.SGD(model.parameters(), lr=config["lr"])
+
+    def scheduler_creator(optimizer, config):
+        return torch.optim.lr_scheduler.StepLR(optimizer, step_size=8,
+                                               gamma=0.9)
+
+    est = TorchEstimator(
+        model=model_creator,
+        optimizer=optimizer_creator,
+        loss=torch.nn.SmoothL1Loss,          # loss as a class
+        lr_scheduler_creator=scheduler_creator,
+        feature_columns=["a", "b"],
+        label_column="y",
+        batch_size=64,
+        num_epochs=10,
+        lr=5e-2,                              # lands in config
+    )
+    history = est.fit_on_df(_linear_df(seed=1))
+    assert history[-1]["train_loss"] < history[0]["train_loss"]
+
+
+def test_eval_get_model_save_restore(tmp_path):
+    est = TorchEstimator(
+        model=TwoColModel(),
+        loss=torch.nn.MSELoss(),
+        feature_columns=["a", "b"],
+        label_column="y",
+        batch_size=64,
+        num_epochs=6,
+    )
+    df = _linear_df(seed=2)
+    est.fit_on_df(df, evaluate_df=df.iloc[:128])
+    assert "eval_loss" in est.history[-1]
+
+    model = est.get_model()
+    x = torch.from_numpy(df[["a", "b"]].to_numpy()[:4])
+    pred = model(x[:, :1], x[:, 1:]).detach().numpy()
+    assert pred.shape == (4, 1)
+
+    path = est.save(str(tmp_path / "ckpt.pt"))
+    est2 = TorchEstimator(
+        model=TwoColModel(), loss=torch.nn.MSELoss(),
+        feature_columns=["a", "b"], label_column="y",
+    )
+    est2.restore(path)
+    pred2 = est2.get_model()(x[:, :1], x[:, 1:]).detach().numpy()
+    np.testing.assert_allclose(pred, pred2, atol=1e-6)
+
+
+def test_classification_accuracy_reported():
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((400, 2)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int64)
+    df = pd.DataFrame(x, columns=["a", "b"])
+    df["y"] = y
+
+    est = TorchEstimator(
+        # Creator form: built after the worker's manual_seed → repeatable.
+        model=lambda config: torch.nn.Sequential(torch.nn.Linear(2, 2)),
+        optimizer=lambda model, config: torch.optim.Adam(
+            model.parameters(), lr=0.05
+        ),
+        loss=torch.nn.CrossEntropyLoss(),
+        feature_columns=["a", "b"],
+        label_column="y",
+        label_type=np.int64,
+        batch_size=64,
+        num_epochs=10,
+    )
+    history = est.fit_on_df(df)
+    assert history[-1]["train_acc"] > 0.85
+
+
+def test_all_shards_consumed_when_more_shards_than_workers():
+    """num_shards > num_workers must not silently drop data: a model
+    trained via fit_on_df(num_shards=4) with one worker still sees every
+    row (regression)."""
+    from raydp_tpu.data.ml_dataset import MLDataset
+    from raydp_tpu.train.estimator import _ensure_df
+
+    df = _linear_df(n=200, seed=5)
+    ds = MLDataset.from_df(_ensure_df(df), num_shards=4)
+    est = TorchEstimator(
+        num_workers=1,
+        model=lambda c: torch.nn.Linear(2, 1),
+        loss=torch.nn.MSELoss(),
+        feature_columns=["a", "b"],
+        label_column="y",
+        num_epochs=1,
+        batch_size=200,  # 1 batch per epoch IF all rows are present
+        drop_last=False,
+        shuffle=False,
+    )
+    est.fit(ds)
+    # With only shard 0 (50 rows) the epoch would have 1 batch of 50;
+    # verify via a second run counting samples through a spying loss.
+    seen = []
+
+    class CountingLoss(torch.nn.MSELoss):
+        def forward(self, inp, tgt):
+            seen.append(len(tgt))
+            return super().forward(inp, tgt)
+
+    est2 = TorchEstimator(
+        num_workers=1,
+        model=lambda c: torch.nn.Linear(2, 1),
+        loss=CountingLoss(),
+        feature_columns=["a", "b"],
+        label_column="y",
+        num_epochs=1,
+        batch_size=200,
+        shuffle=False,
+    )
+    est2.fit(ds)
+    assert sum(seen) == 200, f"only {sum(seen)} of 200 rows trained"
+
+
+def test_regression_targets_in_unit_interval_get_no_accuracy():
+    """Float targets in [0,1] are regression, not binary classification
+    (regression: bogus train_acc was reported)."""
+    rng = np.random.default_rng(7)
+    x = rng.random((128, 2)).astype(np.float32)
+    y = (0.3 + 0.4 * x[:, 0]).astype(np.float32)  # floats strictly in (0,1)
+    df = pd.DataFrame(x, columns=["a", "b"])
+    df["y"] = y
+    est = TorchEstimator(
+        model=lambda c: torch.nn.Linear(2, 1),
+        loss=torch.nn.MSELoss(),
+        feature_columns=["a", "b"],
+        label_column="y",
+        num_epochs=2,
+        batch_size=64,
+    )
+    history = est.fit_on_df(df)
+    assert "train_acc" not in history[-1]
+
+
+def test_distributed_gloo_two_workers():
+    """num_workers=2: gang via the SPMD runner, gloo DDP allreduce
+    (reference: 2-worker TorchEstimator, test_torch.py:28-80)."""
+    import sys
+
+    import cloudpickle
+
+    # Classes defined in this test module must ship to the gang by value
+    # (rank processes cannot import pytest's test module).
+    cloudpickle.register_pickle_by_value(sys.modules[__name__])
+    est = TorchEstimator(
+        num_workers=2,
+        model=TwoColModel(),
+        optimizer=torch.optim.Adam(TwoColModel().parameters(), lr=5e-2),
+        loss=torch.nn.MSELoss(),
+        feature_columns=["a", "b"],
+        label_column="y",
+        batch_size=32,
+        num_epochs=4,
+    )
+    history = est.fit_on_df(_linear_df(n=256, seed=4))
+    assert len(history) == 4
+    assert history[-1]["train_loss"] < history[0]["train_loss"]
+    assert est.get_model() is not None

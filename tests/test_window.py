@@ -247,6 +247,37 @@ def test_rank_with_nulls():
     assert got[-1] == 1 and got[1.0] == 2 and got[2.0] == 3 and got[3.0] == 4
 
 
+def test_row_number_null_ordering():
+    """Spark orders nulls first on ascending keys — row_number and rank
+    must agree on which row is first."""
+    pdf = pd.DataFrame({"g": ["a"] * 4, "v": [3.0, None, 1.0, 2.0]})
+    df = rdf.from_pandas(pdf, num_partitions=2)
+    w = Window.partitionBy("g").orderBy("v")
+    out = (
+        df.withColumn("rn", row_number().over(w))
+        .withColumn("rk", rank().over(w))
+        .to_pandas()
+    )
+    null_row = out[out.v.isna()].iloc[0]
+    assert null_row.rn == 1 and null_row.rk == 1
+    # Descending: nulls last.
+    w2 = Window.partitionBy("g").orderBy(desc("v"))
+    out2 = df.withColumn("rn", row_number().over(w2)).to_pandas()
+    assert out2[out2.v.isna()].rn.iloc[0] == 4
+
+
+def test_window_sum_range_frame_ties():
+    """Spark's default frame is RANGE: peer rows (tied order keys) all
+    receive the full peer-inclusive running total."""
+    pdf = pd.DataFrame({"g": ["a"] * 3, "t": [1, 1, 2],
+                        "v": [1.0, 2.0, 3.0]})
+    df = rdf.from_pandas(pdf, num_partitions=1)
+    w = Window.partitionBy("g").orderBy("t")
+    out = df.withColumn("run", window_sum("v").over(w)).to_pandas()
+    got = sorted(zip(out.t, out.run))
+    assert got == [(1, 3.0), (1, 3.0), (2, 6.0)]
+
+
 def test_window_sum_running_with_orderby():
     pdf = pd.DataFrame({"g": ["a"] * 3 + ["b"], "t": [1, 2, 3, 1],
                         "v": [1.0, 2.0, 3.0, 5.0]})
