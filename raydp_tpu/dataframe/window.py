@@ -208,7 +208,16 @@ class WindowExpr(Expr):
                 peer_cols = [ordered[c] for c in keys] + [
                     ordered[k.column] for k in order
                 ]
-                out = csum.groupby(peer_cols, dropna=False).transform("max")
+                # Peer total = cumsum at the group's LAST row ("max" would
+                # be wrong for negative values: cumsum isn't monotone).
+                out = csum.groupby(peer_cols, dropna=False).transform("last")
+                # A peer group whose values are all null has no cumsum of
+                # its own; Spark carries the prior frame total forward
+                # (leading nulls stay null: empty frame sums to null).
+                if out.isna().any():
+                    out = out.groupby(
+                        [ordered[c] for c in keys], dropna=False
+                    ).ffill()
             else:
                 out = grouped[self.fn.column].transform("sum")
         else:

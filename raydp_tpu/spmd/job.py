@@ -311,11 +311,18 @@ class SPMDJob:
             fn_blob = cloudpickle.dumps(fn)
             for rank, stub in self._stubs.items():
                 payload = {"func_id": self._func_id, "fn": fn_blob}
+                # Deadline sized to the payload (fn closure + scatter blob)
+                # at a worst-case ~10 MB/s over DCN, on top of the control
+                # default — NOT the whole-job timeout, which would let the
+                # serial send loop hide failures for world×timeout.
+                nbytes = len(fn_blob)
                 if per_rank_args is not None:
-                    payload["args"] = cloudpickle.dumps(
-                        tuple(per_rank_args[rank])
-                    )
-                stub.call("RunFunction", payload, timeout=10.0)
+                    blob = cloudpickle.dumps(tuple(per_rank_args[rank]))
+                    payload["args"] = blob
+                    nbytes += len(blob)
+                stub.call(
+                    "RunFunction", payload, timeout=10.0 + nbytes / 10e6
+                )
             if not results.done.wait(timeout or max(self.timeout, 60.0)):
                 raise SPMDJobError(
                     f"function {self._func_id} timed out on job {self.job_name}"

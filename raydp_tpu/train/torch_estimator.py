@@ -57,15 +57,20 @@ def _build_optimizer(spec, model, config):
     if isinstance(spec, torch.optim.Optimizer):
         # Instance case: re-bind onto this process's model parameters,
         # keeping hyperparameters (reference rewrites likewise,
-        # torch/estimator.py:164-171).
-        cls = spec.__class__
-        state = spec.state_dict()
-        opt = cls(model.parameters(), lr=1e-3)
-        try:
-            opt.load_state_dict(state)
-        except (ValueError, KeyError):
-            pass  # param groups differ; keep defaults
-        return opt
+        # torch/estimator.py:164-171). The constructor defaults carry
+        # lr/momentum/etc; multi-param-group schedules cannot survive a
+        # rebind onto a fresh model, so flag that instead of silently
+        # training with different hyperparameters.
+        if len(spec.param_groups) > 1:
+            raise ValueError(
+                "optimizer instances with multiple param groups cannot be "
+                "re-bound onto worker models; pass a creator function "
+                "`lambda model, config: ...` instead"
+            )
+        hyper = {
+            k: spec.param_groups[0].get(k, v) for k, v in spec.defaults.items()
+        }
+        return spec.__class__(model.parameters(), **hyper)
     if callable(spec):
         return spec(model, config) if _arity(spec) >= 2 else spec(model)
     if spec is None:
@@ -79,7 +84,9 @@ def _build_loss(spec, config):
     loss_cls = torch.nn.modules.loss._Loss
     if inspect.isclass(spec) and issubclass(spec, loss_cls):
         return spec()
-    if isinstance(spec, loss_cls):
+    # Any Module instance is a criterion to use as-is (custom losses
+    # usually subclass nn.Module, not the private _Loss).
+    if isinstance(spec, (loss_cls, torch.nn.Module)):
         return spec
     if callable(spec):
         return spec(config) if _arity(spec) >= 1 else spec()
@@ -106,6 +113,64 @@ def _concat_columns(
     }
 
 
+def _all_rows(ds: MLDataset, columns: Sequence[str]) -> Dict[str, np.ndarray]:
+    """Every distinct row once, one column dict. Shards are wrap-padded to
+    equal size (sharding.py divide_blocks), so the concat is sliced back to
+    ``total_rows`` — keeping the padding would double-count head rows."""
+    full = _concat_columns(
+        [ds.shard_columns(s, list(columns)) for s in range(ds.num_shards)]
+    )
+    return {k: v[: ds.total_rows] for k, v in full.items()}
+
+
+def _true_shard_sizes(ds: MLDataset) -> List[int]:
+    """Rows each shard contributes to the original sequence (the last
+    shard's wrap-around padding excluded)."""
+    padded = [
+        sum(s.num_samples for s in ds.shard_plan[r])
+        for r in range(ds.num_shards)
+    ]
+    total, out, seen = ds.total_rows, [], 0
+    for n in padded:
+        out.append(min(n, max(0, total - seen)))
+        seen += n
+    return out
+
+
+def _rows_range(
+    ds: MLDataset,
+    columns: Sequence[str],
+    start: int,
+    count: int,
+    cache: Optional[Dict[int, Dict[str, np.ndarray]]] = None,
+) -> Dict[str, np.ndarray]:
+    """``count`` rows of the shard-concatenated dataset starting at global
+    row ``start``, wrapping modulo total (equal-rank-rows top-up). Only the
+    shards overlapping the range are materialized; ``cache`` (if given)
+    holds the last decoded shard so consecutive ranks sharing a boundary
+    shard decode it once."""
+    total = ds.total_rows
+    sizes = _true_shard_sizes(ds)
+    bounds = np.cumsum([0] + sizes)
+    pieces: List[Dict[str, np.ndarray]] = []
+    pos, need = start % total, count
+    while need:
+        shard = int(np.searchsorted(bounds, pos, side="right") - 1)
+        local = pos - bounds[shard]
+        n = min(need, sizes[shard] - local)
+        if cache is not None and shard in cache:
+            cols = cache[shard]
+        else:
+            cols = ds.shard_columns(shard, list(columns))
+            if cache is not None:
+                cache.clear()  # keep at most one shard resident
+                cache[shard] = cols
+        pieces.append({k: v[local:local + n] for k, v in cols.items()})
+        pos = (pos + n) % total
+        need -= n
+    return _concat_columns(pieces)
+
+
 def _model_wants_columns(model) -> bool:
     """Reference models take one tensor per feature column
     (model(*cols), torch/estimator.py:233-234); single-arg forwards get
@@ -130,12 +195,10 @@ def _accuracy(outputs, targets) -> float:
             pred = outputs.argmax(-1)
             return (pred == targets.long().view(pred.shape)).float().mean().item()
         flat = outputs.view(-1)
-        # Binary accuracy only for genuinely binary targets: integer
-        # dtypes, or floats that are exactly 0/1 (a float target in [0,1]
-        # is regression, not classification).
-        is_binary = targets.dtype in (torch.int64, torch.int32) or bool(
-            ((targets == 0) | (targets == 1)).all()
-        )
+        # Binary accuracy only for genuinely binary targets (values all
+        # exactly 0/1, whatever the dtype). Integer targets over a wider
+        # range with a single output head are (count/ordinal) regression.
+        is_binary = bool(((targets == 0) | (targets == 1)).all())
         if is_binary:
             pred = (torch.sigmoid(flat) > 0.5).long()
             return (pred == targets.long().view(-1)).float().mean().item()
@@ -331,21 +394,21 @@ class TorchEstimator:
             raise ValueError("feature_columns and label_column are required")
         wanted = list(cfg["feature_columns"]) + [cfg["label_column"]]
         world = min(self.num_workers, train_ds.num_shards)
-        # Every shard is consumed: rank r takes shards r, r+world, … so a
-        # dataset with more shards than workers still trains on all rows.
+        # Equal samples per rank (reference invariant: divide_blocks gives
+        # every rank exactly ceil(total/world) rows, wrapping to reuse early
+        # rows — utils.py:149-222). Equality matters for DDP: ranks with
+        # different batch counts desynchronize the gloo allreduce. Rows are
+        # gathered shard-slice by shard-slice so the driver never holds a
+        # second full copy of the dataset.
+        total = train_ds.total_rows
+        per = -(-total // world)
+        shard_cache: Dict[int, Dict[str, np.ndarray]] = {}
         shards = [
-            _concat_columns(
-                [
-                    train_ds.shard_columns(s, wanted)
-                    for s in range(r, train_ds.num_shards, world)
-                ]
-            )
+            _rows_range(train_ds, wanted, r * per, per, cache=shard_cache)
             for r in range(world)
         ]
         eval_shard = (
-            evaluate_ds.shard_columns(0, wanted)
-            if evaluate_ds is not None
-            else None
+            _all_rows(evaluate_ds, wanted) if evaluate_ds is not None else None
         )
         if world == 1:
             out = _train_on_shard(
@@ -424,7 +487,7 @@ class TorchEstimator:
     def evaluate(self, ds: MLDataset) -> Dict[str, float]:
         cfg = self.config
         wanted = list(cfg["feature_columns"]) + [cfg["label_column"]]
-        shard = ds.shard_columns(0, wanted)
+        shard = _all_rows(ds, wanted)
         model = self.get_model()
         criterion = _build_loss(cfg["loss"], cfg)
         return _evaluate_shard(
@@ -451,4 +514,3 @@ class TorchEstimator:
     def shutdown(self) -> None:
         """Reference parity (torch/estimator.py:327-330); gangs are
         per-fit here, so nothing is left running."""
-        self._trained_state = self._trained_state  # no-op

@@ -176,6 +176,102 @@ def test_all_shards_consumed_when_more_shards_than_workers():
     assert sum(seen) == 200, f"only {sum(seen)} of 200 rows trained"
 
 
+def test_custom_module_loss_instance():
+    """A loss that subclasses nn.Module (not the private _Loss) is used
+    as the criterion, not mistaken for a creator fn (regression)."""
+
+    class HuberLike(torch.nn.Module):
+        def forward(self, inp, tgt):
+            return ((inp - tgt) ** 2).mean()
+
+    est = TorchEstimator(
+        model=lambda c: torch.nn.Linear(2, 1),
+        loss=HuberLike(),
+        feature_columns=["a", "b"],
+        label_column="y",
+        num_epochs=2,
+        batch_size=64,
+    )
+    history = est.fit_on_df(_linear_df(n=128, seed=6))
+    assert history[-1]["train_loss"] < history[0]["train_loss"]
+
+
+def test_evaluate_uses_all_shards():
+    """evaluate() on a multi-shard dataset scores every row, not just
+    shard 0 (regression)."""
+    from raydp_tpu.data.ml_dataset import MLDataset
+    from raydp_tpu.train.estimator import _ensure_df
+
+    seen = []
+
+    class CountingLoss(torch.nn.MSELoss):
+        def forward(self, inp, tgt):
+            seen.append(len(tgt))
+            return super().forward(inp, tgt)
+
+    # 121 % 4 != 0: shards are wrap-padded, which evaluate must NOT
+    # double-count (regression: padding rows were scored twice).
+    df = _linear_df(n=121, seed=8)
+    est = TorchEstimator(
+        model=lambda c: torch.nn.Linear(2, 1),
+        loss=CountingLoss(),
+        feature_columns=["a", "b"],
+        label_column="y",
+        num_epochs=1,
+        batch_size=121,
+        shuffle=False,
+    )
+    ds = MLDataset.from_df(_ensure_df(df), num_shards=1)
+    est.fit(ds)
+    seen.clear()
+    eval_ds = MLDataset.from_df(_ensure_df(df), num_shards=4)
+    est.evaluate(eval_ds)
+    assert seen == [121], f"evaluate saw {seen} rows, wanted exactly 121"
+
+
+def test_int_targets_beyond_binary_get_no_accuracy():
+    """Integer targets over 0..9 with a single-output head are count
+    regression — no bogus binary accuracy (regression: int dtype alone
+    triggered the binary branch)."""
+    rng = np.random.default_rng(11)
+    x = rng.random((128, 2)).astype(np.float32)
+    df = pd.DataFrame(x, columns=["a", "b"])
+    df["y"] = rng.integers(0, 10, 128).astype(np.int64)
+    est = TorchEstimator(
+        model=lambda c: torch.nn.Linear(2, 1),
+        loss=torch.nn.MSELoss(),
+        feature_columns=["a", "b"],
+        label_column="y",
+        label_type=np.float32,
+        num_epochs=1,
+        batch_size=64,
+    )
+    history = est.fit_on_df(df)
+    assert "train_acc" not in history[-1]
+
+
+def test_optimizer_instance_hyperparams_preserved():
+    """Re-binding an optimizer instance keeps its lr/momentum; multi
+    param-group instances are rejected loudly instead of silently
+    retrained at defaults (regression)."""
+    from raydp_tpu.train.torch_estimator import _build_optimizer
+
+    model = torch.nn.Linear(2, 1)
+    src = torch.optim.SGD(torch.nn.Linear(2, 1).parameters(),
+                          lr=0.05, momentum=0.9)
+    opt = _build_optimizer(src, model, {})
+    assert opt.param_groups[0]["lr"] == 0.05
+    assert opt.param_groups[0]["momentum"] == 0.9
+
+    body, head = torch.nn.Linear(2, 2), torch.nn.Linear(2, 1)
+    multi = torch.optim.SGD(
+        [{"params": body.parameters(), "lr": 0.01},
+         {"params": head.parameters(), "lr": 0.1}]
+    )
+    with pytest.raises(ValueError, match="param groups"):
+        _build_optimizer(multi, model, {})
+
+
 def test_regression_targets_in_unit_interval_get_no_accuracy():
     """Float targets in [0,1] are regression, not binary classification
     (regression: bogus train_acc was reported)."""
@@ -220,3 +316,25 @@ def test_distributed_gloo_two_workers():
     assert len(history) == 4
     assert history[-1]["train_loss"] < history[0]["train_loss"]
     assert est.get_model() is not None
+
+
+def test_distributed_uneven_shards_do_not_hang():
+    """num_shards=3 with num_workers=2: every rank gets exactly
+    ceil(total/world) rows so the gloo allreduce stays in lockstep
+    (regression: strided shard assignment hung the gang)."""
+    import sys
+
+    import cloudpickle
+
+    cloudpickle.register_pickle_by_value(sys.modules[__name__])
+    est = TorchEstimator(
+        num_workers=2,
+        model=TwoColModel(),
+        loss=torch.nn.MSELoss(),
+        feature_columns=["a", "b"],
+        label_column="y",
+        batch_size=16,
+        num_epochs=1,
+    )
+    history = est.fit_on_df(_linear_df(n=96, seed=9), num_shards=3)
+    assert len(history) == 1 and np.isfinite(history[0]["train_loss"])

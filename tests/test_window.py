@@ -278,6 +278,36 @@ def test_window_sum_range_frame_ties():
     assert got == [(1, 3.0), (1, 3.0), (2, 6.0)]
 
 
+def test_window_sum_range_frame_negative_values():
+    """Peer-group total is the LAST cumsum value, not the max — with
+    negative values cumsum is not monotone (regression: transform("max")
+    overstated the total)."""
+    pdf = pd.DataFrame({"g": ["a"] * 3, "t": [1, 1, 2],
+                        "v": [5.0, -2.0, 1.0]})
+    df = rdf.from_pandas(pdf, num_partitions=1)
+    w = Window.partitionBy("g").orderBy("t")
+    out = df.withColumn("run", window_sum("v").over(w)).to_pandas()
+    got = sorted(zip(out.t, out.run))
+    assert got == [(1, 3.0), (1, 3.0), (2, 4.0)]
+
+
+def test_window_sum_all_null_peer_group_carries_total_forward():
+    """A peer group whose values are all null keeps the prior running
+    total (Spark: sum over a frame ignores nulls); leading null frames
+    stay null."""
+    pdf = pd.DataFrame({
+        "g": ["a"] * 3 + ["b"],
+        "t": [1, 2, 3, 1],
+        "v": [1.0, None, 2.0, None],
+    })
+    df = rdf.from_pandas(pdf, num_partitions=1)
+    w = Window.partitionBy("g").orderBy("t")
+    out = df.withColumn("run", window_sum("v").over(w)).to_pandas()
+    a = out[out.g == "a"].sort_values("t")
+    assert a.run.tolist() == [1.0, 1.0, 3.0]
+    assert np.isnan(out[out.g == "b"].run.iloc[0])
+
+
 def test_window_sum_running_with_orderby():
     pdf = pd.DataFrame({"g": ["a"] * 3 + ["b"], "t": [1, 2, 3, 1],
                         "v": [1.0, 2.0, 3.0, 5.0]})
