@@ -20,7 +20,6 @@ import logging
 import os
 import secrets
 import subprocess
-import sys
 import threading
 import time
 from concurrent.futures import Future, ThreadPoolExecutor
@@ -29,9 +28,11 @@ from typing import Any, Callable, Dict, List, Optional
 import cloudpickle
 
 from raydp_tpu.cluster import placement as pl
+from raydp_tpu.cluster.launcher import LaunchSpec, LocalLauncher, WorkerLauncher
 from raydp_tpu.cluster.master import AppMaster, WorkerInfo
 from raydp_tpu.cluster.rpc import RpcClient
 from raydp_tpu.config import ClusterConfig
+from raydp_tpu.store.object_store import DEFAULT_NODE
 
 logger = logging.getLogger(__name__)
 
@@ -46,12 +47,16 @@ class Cluster:
         self.namespace = f"{_slug(config.app_name)}-{secrets.token_hex(3)}"
         self.master: Optional[AppMaster] = None
         self.pg: Optional[pl.PlacementGroup] = None
+        self.launcher: WorkerLauncher = config.launcher or LocalLauncher()
         self._procs: Dict[str, subprocess.Popen] = {}
+        self._worker_nodes: Dict[str, str] = {}
+        self._agent_procs: Dict[str, subprocess.Popen] = {}
         self._worker_clients: Dict[str, RpcClient] = {}
         self._worker_seq = itertools.count()
         self._rr = itertools.count()  # round-robin task cursor
         self._lock = threading.RLock()
         self._pool = ThreadPoolExecutor(max_workers=32)
+        self._resolver = None
         self._log_dir = os.path.join(
             "/tmp/raydp_tpu", f"{_slug(config.app_name)}-{os.getpid()}"
         )
@@ -59,9 +64,20 @@ class Cluster:
     # -- lifecycle ------------------------------------------------------
     def start(self) -> None:
         os.makedirs(self._log_dir, exist_ok=True)
-        self.master = AppMaster(self.namespace)
+        nodes = (
+            pl.detect_nodes(self.config.num_virtual_nodes)
+            if self.config.num_virtual_nodes
+            else None
+        )
+        self.master = AppMaster(
+            self.namespace,
+            nodes=nodes,
+            bind_host=self.config.bind_host,
+            advertise_host=self.config.advertise_host,
+        )
         try:
             self._place_group()
+            self._spawn_agents()
             self.master.expect_workers(self.config.num_workers)
             for _ in range(self.config.num_workers):
                 self._spawn_worker()
@@ -80,6 +96,50 @@ class Cluster:
             self.config.num_workers,
             self.master.address,
         )
+
+    def _spawn_agents(self) -> None:
+        self._ensure_agents(
+            self._bundle_node(i) for i in range(self.config.num_workers)
+        )
+
+    def _ensure_agents(self, node_ids) -> None:
+        """One store agent per non-driver node that hosts workers (the
+        per-node data-plane process; the driver node's agent is embedded in
+        the master). Idempotent — called again when dynamic allocation
+        lands workers on new nodes."""
+        with self._lock:
+            agent_nodes = (
+                set(node_ids) - {DEFAULT_NODE} - set(self._agent_procs)
+            )
+        if not agent_nodes:
+            return
+        for node_id in sorted(agent_nodes):
+            spec = LaunchSpec(
+                argv=[
+                    "-m",
+                    "raydp_tpu.store.agent",
+                    "--namespace",
+                    self.namespace,
+                    "--node-id",
+                    node_id,
+                    "--master",
+                    self.master.address,
+                    "--bind-host",
+                    self.config.bind_host,
+                ],
+                node_id=node_id,
+                log_path=os.path.join(self._log_dir, f"agent-{node_id}.log"),
+                cwd=_repo_root(),
+            )
+            with self._lock:
+                self._agent_procs[node_id] = self.launcher.launch(spec)
+        with self._lock:
+            all_agent_nodes = set(self._agent_procs)
+        self.master.expect_agents(all_agent_nodes)
+        if not self.master.wait_for_agents(60.0):
+            raise ClusterError(
+                f"store agents failed to register (logs: {self._log_dir})"
+            )
 
     def _place_group(self) -> None:
         if self.config.placement_group is not None:
@@ -101,7 +161,13 @@ class Cluster:
 
     def _bundle_node(self, index: int) -> str:
         if self.pg is None:
-            return "node-0"
+            # No placement group: on a multi-node cluster, spread workers
+            # round-robin over nodes so every host gets a data-plane
+            # presence; single node degenerates to node-0.
+            nodes = self.master.nodes if self.master is not None else []
+            if len(nodes) > 1:
+                return nodes[index % len(nodes)].node_id
+            return DEFAULT_NODE
         indexes = self.config.placement_bundle_indexes
         if indexes is not None:
             index = indexes[index % len(indexes)]
@@ -113,11 +179,8 @@ class Cluster:
         seq = next(self._worker_seq)
         worker_id = f"w{seq}"
         node_id = self._bundle_node(seq)
-        log_path = os.path.join(self._log_dir, f"{worker_id}.log")
-        log_file = open(log_path, "ab")
-        proc = subprocess.Popen(
-            [
-                sys.executable,
+        spec = LaunchSpec(
+            argv=[
                 "-m",
                 "raydp_tpu.cluster.worker_main",
                 "--worker-id",
@@ -130,15 +193,18 @@ class Cluster:
                 str(self.config.cores_per_worker),
                 "--memory",
                 str(self.config.memory_per_worker),
+                "--bind-host",
+                self.config.bind_host,
             ],
-            stdout=log_file,
-            stderr=subprocess.STDOUT,
-            cwd=os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
-            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            node_id=node_id,
+            log_path=os.path.join(self._log_dir, f"{worker_id}.log"),
+            env={"JAX_PLATFORMS": "cpu"},
+            cwd=_repo_root(),
         )
-        log_file.close()
+        proc = self.launcher.launch(spec)
         with self._lock:
             self._procs[worker_id] = proc
+            self._worker_nodes[worker_id] = node_id
         return worker_id
 
     def shutdown(self, del_obj_holder: bool = True, fast: bool = False) -> None:
@@ -152,6 +218,9 @@ class Cluster:
         with self._lock:
             worker_ids = list(self._procs)
         if fast:
+            # Workers die hard; agents are NOT terminated here — they must
+            # stay reachable so release_holder() can broadcast DestroyStore
+            # before stopping them (else remote-node segments leak).
             with self._lock:
                 procs = list(self._procs.values())
                 self._procs.clear()
@@ -169,15 +238,45 @@ class Cluster:
         if self.master is not None:
             if del_obj_holder:
                 self.release_holder()
+        # Note: with del_obj_holder=False the store agents stay up — holder
+        # objects on remote nodes must remain fetchable until
+        # release_holder() (reference: stop_spark(del_obj_holder=False),
+        # context.py:208-215).
 
     def release_holder(self) -> None:
-        """Unlink holder-owned objects and stop the master service."""
+        """Unlink holder-owned objects, stop agents + the master service."""
         if self.master is None:
             return
         self.master.release_holder()
-        self.master.store.destroy()
+        self.master.store.destroy()  # broadcasts DestroyStore to agents
+        self._stop_agents()
+        # Backstop for same-machine virtual nodes (and crashed agents):
+        # sweep every segment of this namespace across ALL node prefixes.
+        from raydp_tpu.store import shm
+
+        for name in shm.list_segments(f"rdp-{self.namespace}-"):
+            shm.unlink(name)
         self.master.shutdown()
         self.master = None
+
+    def _stop_agents(self) -> None:
+        with self._lock:
+            procs = dict(self._agent_procs)
+            self._agent_procs.clear()
+        for node_id, proc in procs.items():
+            agent = self.master.store.agent_for(node_id) if self.master else None
+            if agent is not None:
+                client = RpcClient(agent["address"], agent["service"])
+                client.try_call("Stop", {}, timeout=2.0)
+                client.close()
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=3)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
 
     def _stop_worker(self, worker_id: str, kill_objects: bool = True) -> None:
         client = self._client_for(worker_id)
@@ -206,6 +305,11 @@ class Cluster:
         current = len(self.alive_workers())
         self.master.expect_workers(current + num_additional)
         ids = [self._spawn_worker() for _ in range(num_additional)]
+        # New workers may land on nodes the initial pool never used; those
+        # nodes need a store agent before any object lands there.
+        with self._lock:
+            new_nodes = [self._worker_nodes[wid] for wid in ids]
+        self._ensure_agents(new_nodes)
         if not self.master.wait_for_workers(60.0):
             raise ClusterError("additional workers failed to register")
         return ids
@@ -214,6 +318,19 @@ class Cluster:
         """Shrink the pool; the worker's non-holder objects are unlinked,
         holder-owned objects survive (shuffle-survival semantics)."""
         self._stop_worker(worker_id, kill_objects=True)
+
+    # -- object access ----------------------------------------------------
+    @property
+    def resolver(self):
+        """Driver-side node-aware reader: local shm for driver-node objects,
+        agent fetch for everything else."""
+        if self._resolver is None:
+            from raydp_tpu.store.resolver import ObjectResolver
+
+            self._resolver = ObjectResolver(
+                self.master.store, self.master.object_meta
+            )
+        return self._resolver
 
     # -- introspection ----------------------------------------------------
     def alive_workers(self) -> List[WorkerInfo]:
@@ -316,3 +433,7 @@ class Cluster:
 
 def _slug(name: str) -> str:
     return "".join(c if c.isalnum() or c == "-" else "-" for c in name.lower())
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(__file__)))

@@ -23,7 +23,9 @@ from typing import Dict, List, Optional
 
 from raydp_tpu.cluster import placement as pl
 from raydp_tpu.cluster.rpc import RpcServer
-from raydp_tpu.store.object_store import OWNER_HOLDER, ObjectRef, ObjectStore
+from raydp_tpu.store.agent import agent_handlers
+from raydp_tpu.store.directory import DirectoryStore
+from raydp_tpu.store.object_store import DEFAULT_NODE, OWNER_HOLDER, ObjectRef
 
 logger = logging.getLogger(__name__)
 
@@ -45,31 +47,45 @@ class WorkerInfo:
 class AppMaster:
     """Control-plane state machine + its gRPC server."""
 
-    def __init__(self, namespace: str, nodes: Optional[List[pl.NodeInfo]] = None):
+    def __init__(
+        self,
+        namespace: str,
+        nodes: Optional[List[pl.NodeInfo]] = None,
+        bind_host: str = "127.0.0.1",
+        advertise_host: Optional[str] = None,
+    ):
         self.namespace = namespace
         self.nodes = nodes if nodes is not None else pl.detect_nodes()
-        self.store = ObjectStore(namespace=namespace)
+        self.node_id = DEFAULT_NODE  # the master lives on the driver node
+        self.store = DirectoryStore(namespace=namespace, node_id=self.node_id)
         self._workers: Dict[str, WorkerInfo] = {}
         self._lock = threading.RLock()
         self._registration_event = threading.Event()
         self._expected_workers = 0
+        self._agent_event = threading.Event()
+        self._expected_agent_nodes: set = set()
         self._monitor_stop = threading.Event()
+        handlers = {
+            "RegisterWorker": self._on_register_worker,
+            "Heartbeat": self._on_heartbeat,
+            "WorkerStopped": self._on_worker_stopped,
+            "RegisterObject": self._on_register_object,
+            "RegisterAgent": self._on_register_agent,
+            "TransferToHolder": self._on_transfer_to_holder,
+            "GetObjectMeta": self._on_get_object_meta,
+            "ListObjects": self._on_list_objects,
+            "DeleteObject": self._on_delete_object,
+            "ListWorkers": self._on_list_workers,
+            "ClusterResources": self._on_cluster_resources,
+            "Ping": lambda req: {"pong": True, "namespace": self.namespace},
+        }
+        # The master doubles as the driver node's store agent (no extra
+        # process on the node the driver already occupies).
+        handlers.update(agent_handlers(self.store))
         self._server = RpcServer(
-            SERVICE,
-            {
-                "RegisterWorker": self._on_register_worker,
-                "Heartbeat": self._on_heartbeat,
-                "WorkerStopped": self._on_worker_stopped,
-                "RegisterObject": self._on_register_object,
-                "TransferToHolder": self._on_transfer_to_holder,
-                "GetObjectMeta": self._on_get_object_meta,
-                "ListObjects": self._on_list_objects,
-                "DeleteObject": self._on_delete_object,
-                "ListWorkers": self._on_list_workers,
-                "ClusterResources": self._on_cluster_resources,
-                "Ping": lambda req: {"pong": True, "namespace": self.namespace},
-            },
+            SERVICE, handlers, host=bind_host, advertise_host=advertise_host
         )
+        self.store.register_agent(self.node_id, self._server.address, SERVICE)
         self._monitor = threading.Thread(
             target=self._monitor_loop, name="raydp-master-monitor", daemon=True
         )
@@ -90,6 +106,23 @@ class AppMaster:
         """Registration barrier (reference:
         RayCoarseGrainedSchedulerBackend.scala:155,180-182)."""
         return self._registration_event.wait(timeout)
+
+    def expect_agents(self, node_ids) -> None:
+        with self._lock:
+            self._expected_agent_nodes = set(node_ids)
+            self._agent_event.clear()
+            self._check_agent_barrier()
+
+    def wait_for_agents(self, timeout: float = 60.0) -> bool:
+        return self._agent_event.wait(timeout)
+
+    def _check_agent_barrier(self) -> None:
+        if self._expected_agent_nodes <= set(self.store.agents()):
+            self._agent_event.set()
+
+    def object_meta(self, object_id: str):
+        """In-process resolver hook: (ref, agent) for the driver."""
+        return self.store.meta(object_id)
 
     def alive_workers(self) -> List[WorkerInfo]:
         with self._lock:
@@ -171,11 +204,20 @@ class AppMaster:
         self.store.register_ref(req["ref"])
         return {}
 
+    def _on_register_agent(self, req: dict) -> dict:
+        self.store.register_agent(
+            req["node_id"], req["address"], req["service"]
+        )
+        with self._lock:
+            self._check_agent_barrier()
+        return {"namespace": self.namespace}
+
     def _on_transfer_to_holder(self, req: dict) -> dict:
         return {"ref": self.store.transfer_to_holder(req["ref"])}
 
     def _on_get_object_meta(self, req: dict) -> dict:
-        return {"ref": self.store.get_ref(req["object_id"])}
+        ref, agent = self.store.meta(req["object_id"])
+        return {"ref": ref, "agent": agent}
 
     def _on_list_objects(self, req: dict) -> dict:
         return {"refs": self.store.refs()}

@@ -33,11 +33,12 @@ class NodeInfo:
         return NodeInfo(self.node_id, self.address, dict(self.resources))
 
 
-def detect_nodes() -> List[NodeInfo]:
+def detect_nodes(num_virtual: Optional[int] = None) -> List[NodeInfo]:
     """Discover cluster nodes. Single-host: one node with psutil resources,
-    or N equal virtual nodes when RAYDP_TPU_VIRTUAL_NODES is set (tests and
-    local multi-node simulation — the reference similarly simulates
-    multi-node with multiple JVMs on one host, SURVEY §4)."""
+    or N equal virtual nodes when requested (``num_virtual`` argument or
+    RAYDP_TPU_VIRTUAL_NODES env — tests and local multi-node simulation;
+    the reference similarly simulates multi-node with multiple JVMs on one
+    host, SURVEY §4)."""
     import psutil
 
     # Logical-resource override, like `ray start --num-cpus N` (the
@@ -46,7 +47,11 @@ def detect_nodes() -> List[NodeInfo]:
         os.environ.get("RAYDP_TPU_NUM_CPUS") or (psutil.cpu_count() or 1)
     )
     mem = float(psutil.virtual_memory().total)
-    n_virtual = int(os.environ.get("RAYDP_TPU_VIRTUAL_NODES", "0"))
+    n_virtual = (
+        num_virtual
+        if num_virtual is not None
+        else int(os.environ.get("RAYDP_TPU_VIRTUAL_NODES", "0"))
+    )
     ip = local_ip()
     if n_virtual <= 1:
         return [NodeInfo("node-0", ip, {"cpu": cpus, "memory": mem})]

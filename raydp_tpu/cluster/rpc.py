@@ -35,6 +35,7 @@ class RpcServer:
         host: str = "127.0.0.1",
         port: int = 0,
         max_workers: int = 16,
+        advertise_host: Optional[str] = None,
     ):
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers),
@@ -57,7 +58,19 @@ class RpcServer:
         self.port = self._server.add_insecure_port(f"{host}:{port}")
         if self.port == 0:
             raise RuntimeError(f"failed to bind {host}:{port}")
-        self.host = host
+        # Advertised (routable) address may differ from the bind address:
+        # binding 0.0.0.0 accepts cross-host connections but peers must dial
+        # a concrete IP (reference: SPMD workers advertise local_ip; the
+        # reference binds Spark RPC on the driver host option,
+        # ray_cluster.py:65-67).
+        if advertise_host:
+            self.host = advertise_host
+        elif host in ("0.0.0.0", "::", ""):
+            from raydp_tpu.utils.net import local_ip
+
+            self.host = local_ip()
+        else:
+            self.host = host
         self._server.start()
 
     @staticmethod

@@ -41,15 +41,27 @@ class WorkerContext:
         self.node_id = node_id
         self.store = store
         self._master = master
+        from raydp_tpu.store.resolver import ObjectResolver
 
-    def put_table(self, table):
-        """Store an Arrow table owned by this worker; returns ObjectRef.
+        self.resolver = ObjectResolver(store, self._object_meta)
 
-        The ref is registered in the master's object directory so owner
-        lifetime is enforced cluster-wide (reference: executor-side
-        Ray.put makes the object cluster-visible, ObjectStoreWriter.scala:58-79).
+    def _object_meta(self, object_id: str):
+        reply = self._master.call("GetObjectMeta", {"object_id": object_id})
+        return reply.get("ref"), reply.get("agent")
+
+    def put_table(self, table, holder: bool = False):
+        """Store an Arrow table; returns ObjectRef.
+
+        Owned by this worker by default (dies with it); ``holder=True``
+        writes it holder-owned up front (ingest data that must survive pool
+        shrinks). The ref is registered in the master's object directory so
+        owner lifetime is enforced cluster-wide (reference: executor-side
+        Ray.put with optional owner, ObjectStoreWriter.scala:58-79).
         """
-        ref = self.store.put_arrow_table(table, owner=self.worker_id)
+        from raydp_tpu.store.object_store import OWNER_HOLDER
+
+        owner = OWNER_HOLDER if holder else self.worker_id
+        ref = self.store.put_arrow_table(table, owner=owner)
         self._master.call("RegisterObject", {"ref": ref})
         return ref
 
@@ -59,12 +71,17 @@ class WorkerContext:
         return ref
 
     def get_table(self, ref):
-        return self.store.get_arrow_table(ref)
+        """Read an Arrow table from anywhere in the cluster: local shm
+        zero-copy, or a gRPC pull from the owning node's store agent."""
+        return self.resolver.get_arrow_table(ref)
+
+    def get_bytes(self, ref):
+        return self.resolver.get_bytes(ref)
 
 
 class Worker:
     def __init__(self, worker_id: str, master_address: str, node_id: str,
-                 resources: dict):
+                 resources: dict, bind_host: str = "127.0.0.1"):
         self.worker_id = worker_id
         self.node_id = node_id
         self.resources = resources
@@ -72,6 +89,10 @@ class Worker:
         self.store: ObjectStore = None  # namespace learned at registration
         self.ctx: WorkerContext = None
         self._stop_event = threading.Event()
+        # The RPC server is up before registration completes, and the master
+        # lists this worker ALIVE the moment RegisterWorker returns — so a
+        # task can arrive while ctx is still being built. Gate on readiness.
+        self._ready = threading.Event()
         self._server = RpcServer(
             WORKER_SERVICE,
             {
@@ -79,6 +100,7 @@ class Worker:
                 "Ping": lambda req: {"pong": True, "worker_id": self.worker_id},
                 "Stop": self._on_stop,
             },
+            host=bind_host,
         )
 
     def register(self) -> None:
@@ -96,13 +118,20 @@ class Worker:
                     },
                 )
                 namespace = reply["namespace"]
-                self.store = ObjectStore(namespace=namespace)
-                from raydp_tpu.store.object_store import set_current_store
+                self.store = ObjectStore(
+                    namespace=namespace, node_id=self.node_id
+                )
+                from raydp_tpu.store.object_store import (
+                    set_current_resolver,
+                    set_current_store,
+                )
 
                 set_current_store(self.store)
                 self.ctx = WorkerContext(
                     self.worker_id, self.node_id, self.store, self.master
                 )
+                set_current_resolver(self.ctx.resolver)
+                self._ready.set()
                 return
             except Exception as exc:
                 last_exc = exc
@@ -113,6 +142,8 @@ class Worker:
         )
 
     def _on_run_task(self, req: dict) -> dict:
+        if not self._ready.wait(timeout=15.0):
+            raise RuntimeError("worker context not ready (registration hung)")
         fn = cloudpickle.loads(req["fn"])
         args = req.get("args", ())
         kwargs = req.get("kwargs", {})
@@ -168,6 +199,7 @@ def main(argv=None) -> int:
     parser.add_argument("--node-id", default="node-0")
     parser.add_argument("--cores", type=float, default=1.0)
     parser.add_argument("--memory", type=float, default=0.0)
+    parser.add_argument("--bind-host", default="127.0.0.1")
     args = parser.parse_args(argv)
 
     logging.basicConfig(
@@ -179,6 +211,7 @@ def main(argv=None) -> int:
         args.master,
         args.node_id,
         {"cpu": args.cores, "memory": args.memory},
+        bind_host=args.bind_host,
     )
     try:
         worker.run()

@@ -34,6 +34,11 @@ class ClusterConfig:
     placement_group: Optional[Any] = None  # pre-created PlacementGroup
     placement_bundle_indexes: Optional[list] = None
     enable_native: bool = True  # use the C++ data-plane library when built
+    # -- multi-host ----------------------------------------------------
+    num_virtual_nodes: int = 0  # >1: simulate N hosts on this machine
+    bind_host: str = "127.0.0.1"  # "0.0.0.0" for real cross-host clusters
+    advertise_host: Optional[str] = None  # routable addr peers dial
+    launcher: Optional[Any] = None  # WorkerLauncher; default LocalLauncher
     extra: Dict[str, Any] = field(default_factory=dict)
 
     @staticmethod
@@ -46,6 +51,10 @@ class ClusterConfig:
         placement_group: Optional[Any] = None,
         placement_bundle_indexes: Optional[list] = None,
         enable_native: bool = True,
+        num_virtual_nodes: int = 0,
+        bind_host: str = "127.0.0.1",
+        advertise_host: Optional[str] = None,
+        launcher: Optional[Any] = None,
         configs: Optional[Dict[str, Any]] = None,
     ) -> "ClusterConfig":
         cfg = ClusterConfig(
@@ -57,6 +66,10 @@ class ClusterConfig:
             placement_group=placement_group,
             placement_bundle_indexes=placement_bundle_indexes,
             enable_native=enable_native,
+            num_virtual_nodes=num_virtual_nodes,
+            bind_host=bind_host,
+            advertise_host=advertise_host,
+            launcher=launcher,
             extra=dict(configs or {}),
         )
         validate_config(cfg)
@@ -116,3 +129,5 @@ def validate_config(cfg: ClusterConfig) -> None:
             "pass either a pre-created placement_group or a "
             "placement_strategy, not both"
         )
+    if cfg.num_virtual_nodes < 0:
+        raise ValueError("num_virtual_nodes must be >= 0")

@@ -63,6 +63,10 @@ def init(
     placement_group: Optional[Any] = None,
     placement_bundle_indexes: Optional[list] = None,
     enable_native: bool = True,
+    num_virtual_nodes: int = 0,
+    bind_host: str = "127.0.0.1",
+    advertise_host: Optional[str] = None,
+    launcher: Optional[Any] = None,
     configs: Optional[Dict[str, Any]] = None,
 ) -> Session:
     """Start the distributed ETL + training session (singleton).
@@ -88,6 +92,10 @@ def init(
             placement_group=placement_group,
             placement_bundle_indexes=placement_bundle_indexes,
             enable_native=enable_native,
+            num_virtual_nodes=num_virtual_nodes,
+            bind_host=bind_host,
+            advertise_host=advertise_host,
+            launcher=launcher,
             configs=configs,
         )
         _session = Session(cfg)

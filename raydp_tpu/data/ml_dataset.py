@@ -74,7 +74,9 @@ class MLDataset:
         session = current_session()
         if session is not None:
             refs = df.to_object_refs(owner_transfer=owner_transfer)
-            store = session.cluster.master.store
+            # The resolver (not the raw store) so blocks written on any
+            # node of a multi-host cluster resolve from the driver.
+            store = session.cluster.resolver
             return MLDataset(refs, num_shards, shuffle, shuffle_seed, store)
         return MLDataset(
             df.collect_partitions(), num_shards, shuffle, shuffle_seed
@@ -204,15 +206,11 @@ class MLDataset:
     def _resolve(self, block: Block) -> pa.Table:
         if isinstance(block, ObjectRef):
             store = self._store
-            if store is None:
-                from raydp_tpu.store.object_store import get_current_store
+            if store is not None:
+                return store.get_arrow_table(block)
+            from raydp_tpu.store.object_store import resolve_ambient_table
 
-                store = get_current_store()
-            if store is None:
-                raise RuntimeError(
-                    "ObjectRef blocks need a live store to resolve"
-                )
-            return store.get_arrow_table(block)
+            return resolve_ambient_table(block)
         return block
 
     def _block_rows(self, block: Block) -> int:

@@ -436,11 +436,13 @@ class DataFrame:
             broadcast_ref = left._executor.store.put_arrow_table(right_table)
 
             def fn(t: pa.Table) -> pa.Table:
-                # Resolved worker-side via the ambient store; only the tiny
-                # ObjectRef travels in the task payload.
-                from raydp_tpu.store.object_store import get_current_store
+                # Resolved worker-side via the ambient resolver (the
+                # broadcast table lives on the driver node; workers on other
+                # nodes pull it from the driver's store agent); only the
+                # tiny ObjectRef travels in the task payload.
+                from raydp_tpu.store.object_store import resolve_ambient_table
 
-                rt = get_current_store().get_arrow_table(broadcast_ref)
+                rt = resolve_ambient_table(broadcast_ref)
                 return _join_aligned(t, rt, keys, join_type)
 
         else:

@@ -15,6 +15,7 @@ Two backends with one interface:
 """
 from __future__ import annotations
 
+import itertools
 import os
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, List, Optional, Sequence
@@ -53,6 +54,10 @@ class Executor:
 
     def put(self, table: pa.Table) -> Any:
         raise NotImplementedError
+
+    def put_many(self, tables: List[pa.Table]) -> List[Any]:
+        """Bulk ingest; overridden where scatter can run concurrently."""
+        return [self.put(t) for t in tables]
 
     def num_rows(self, part: Any) -> int:
         raise NotImplementedError
@@ -128,12 +133,22 @@ class ClusterExecutor(Executor):
     def __init__(self, cluster):
         self.cluster = cluster
         self.store: ObjectStore = cluster.master.store
+        self._put_rr = itertools.count()
 
-    # Stable partition→worker routing for locality.
-    def _worker_for(self, index: int) -> Optional[str]:
+    # Stable partition→worker routing, locality-first: a partition ref is
+    # routed to a worker on the node where its bytes already live (zero-copy
+    # shm read), falling back to index round-robin. The reference does the
+    # same via getPreferredLocations (RayDatasetRDD.scala:53-55).
+    def _worker_for(self, index: int, ref=None) -> Optional[str]:
         workers = self.cluster.alive_workers()
         if not workers:
             return None
+        if isinstance(ref, ObjectRef):
+            local = sorted(
+                w.worker_id for w in workers if w.node_id == ref.node_id
+            )
+            if local:
+                return local[index % len(local)]
         ordered = sorted(w.worker_id for w in workers)
         return ordered[index % len(ordered)]
 
@@ -143,7 +158,9 @@ class ClusterExecutor(Executor):
             return ctx.put_table(fn(table))
 
         futures = [
-            self.cluster.submit_async(task, ref, worker_id=self._worker_for(i))
+            self.cluster.submit_async(
+                task, ref, worker_id=self._worker_for(i, ref)
+            )
             for i, ref in enumerate(parts)
         ]
         return [f.result() for f in futures]
@@ -155,7 +172,7 @@ class ClusterExecutor(Executor):
 
         futures = [
             self.cluster.submit_async(task, ref, i,
-                                      worker_id=self._worker_for(i))
+                                      worker_id=self._worker_for(i, ref))
             for i, ref in enumerate(parts)
         ]
         return [f.result() for f in futures]
@@ -167,7 +184,7 @@ class ClusterExecutor(Executor):
 
         futures = [
             self.cluster.submit_async(split_task, ref,
-                                      worker_id=self._worker_for(i))
+                                      worker_id=self._worker_for(i, ref))
             for i, ref in enumerate(parts)
         ]
         chunk_refs = [f.result() for f in futures]  # [n_in][n_out]
@@ -195,10 +212,37 @@ class ClusterExecutor(Executor):
         return outs
 
     def materialize(self, part):
-        return self.store.get_arrow_table(part)
+        return self.cluster.resolver.get_arrow_table(part)
 
     def put(self, table):
-        return self.store.put_arrow_table(table)
+        return self._put_async(table).result()
+
+    def put_many(self, tables):
+        # Scatter concurrently: ingest wall-clock is the slowest single
+        # transfer, not the sum.
+        return [f.result() for f in [self._put_async(t) for t in tables]]
+
+    def _put_async(self, table):
+        """Ingest a partition: scattered to a worker round-robin so initial
+        placement is distributed across nodes (Spark parallelize lands
+        blocks on executors, not the driver) — without this, every
+        partition would start on the driver node and locality routing
+        would keep all work there. Written holder-owned: base data must
+        survive pool shrinks (kill_worker contract)."""
+        workers = self.cluster.alive_workers()
+        if not workers:
+            from concurrent.futures import Future
+
+            f = Future()
+            f.set_result(self.store.put_arrow_table(table))
+            return f
+        ordered = sorted(w.worker_id for w in workers)
+        target = ordered[next(self._put_rr) % len(ordered)]
+
+        def ingest(ctx, t):
+            return ctx.put_table(t, holder=True)
+
+        return self.cluster.submit_async(ingest, table, worker_id=target)
 
     def num_rows(self, part):
         return part.num_rows if isinstance(part, ObjectRef) else -1
@@ -208,7 +252,8 @@ class ClusterExecutor(Executor):
             return _sample_table(ctx.get_table(ref), column, k)
 
         futures = [
-            self.cluster.submit_async(task, ref, worker_id=self._worker_for(i))
+            self.cluster.submit_async(task, ref,
+                                      worker_id=self._worker_for(i, ref))
             for i, ref in enumerate(parts)
         ]
         return [f.result() for f in futures]

@@ -24,7 +24,7 @@ def _executor() -> "Executor":
 
 def _distribute(tables: List[pa.Table], executor: Optional[Executor] = None) -> DataFrame:
     ex = executor or _executor()
-    return DataFrame([ex.put(t) for t in tables], ex)
+    return DataFrame(ex.put_many(tables), ex)
 
 
 def from_arrow(table: pa.Table, num_partitions: int = 1) -> DataFrame:

@@ -32,11 +32,15 @@ import pyarrow as pa
 from raydp_tpu.store import shm
 
 OWNER_HOLDER = "__holder__"
+DEFAULT_NODE = "node-0"
 
-# Process-wide "ambient" store: set by worker processes at registration so
-# shipped stage closures can resolve ObjectRefs (e.g. broadcast tables)
-# without threading a context handle through every callable.
+# Process-wide "ambient" store/resolver: set by worker processes at
+# registration so shipped stage closures can resolve ObjectRefs (e.g.
+# broadcast tables) without threading a context handle through every
+# callable. The resolver (when set) additionally reaches objects on OTHER
+# nodes via their store agents.
 _current_store: "ObjectStore | None" = None
+_current_resolver = None
 
 
 def set_current_store(store: "ObjectStore") -> None:
@@ -48,29 +52,64 @@ def get_current_store() -> "ObjectStore | None":
     return _current_store
 
 
+def set_current_resolver(resolver) -> None:
+    global _current_resolver
+    _current_resolver = resolver
+
+
+def get_current_resolver():
+    return _current_resolver
+
+
+def resolve_ambient_table(ref) -> pa.Table:
+    """Read an Arrow table by ref using whatever this process has: the
+    node-aware resolver if one is installed, else the plain local store."""
+    if _current_resolver is not None:
+        return _current_resolver.get_arrow_table(ref)
+    if _current_store is not None:
+        return _current_store.get_arrow_table(ref)
+    raise RuntimeError("no ambient object store/resolver in this process")
+
+
 @dataclass(frozen=True)
 class ObjectRef:
-    """Handle to an immutable object in the store."""
+    """Handle to an immutable object in the store.
+
+    ``node_id`` is the object's physical location — the basis of
+    locality-aware scheduling and cross-host fetch (the reference threads
+    the owner address through every ref for the same purpose,
+    reference: ObjectStoreWriter.scala:49-53 RecordBatch.ownerAddress,
+    rdd/RayDatasetRDD.scala:53-55 getPreferredLocations).
+    """
 
     object_id: str  # 16-byte hex
     size: int
     owner: str
     num_rows: int = -1  # >=0 when the object is an Arrow IPC table
+    node_id: str = DEFAULT_NODE
 
     def __repr__(self):
-        return f"ObjectRef({self.object_id[:8]}…, {self.size}B, owner={self.owner})"
+        return (
+            f"ObjectRef({self.object_id[:8]}…, {self.size}B, "
+            f"owner={self.owner}, node={self.node_id})"
+        )
 
 
 class ObjectStore:
-    """Directory + shm segments under one namespace.
+    """Directory + shm segments under one namespace, scoped to one node.
 
-    ``namespace`` isolates sessions: segment names are
-    ``rdp-<namespace>-<object_id>``.
+    ``namespace`` isolates sessions; ``node_id`` isolates hosts: segment
+    names are ``rdp-<namespace>-<node_id>-<object_id>``. On a real
+    multi-host deployment each host's /dev/shm is physically separate; the
+    node prefix makes single-machine tests behave the same way (a process
+    configured for node A cannot open node B's segments), forcing the
+    cross-host fetch path through the store agents.
     """
 
-    def __init__(self, namespace: Optional[str] = None):
+    def __init__(self, namespace: Optional[str] = None, node_id: str = DEFAULT_NODE):
         self.namespace = namespace or secrets.token_hex(4)
-        self._prefix = f"rdp-{self.namespace}-"
+        self.node_id = node_id
+        self._prefix = f"rdp-{self.namespace}-{node_id}-"
         self._lock = threading.RLock()
         self._objects: Dict[str, ObjectRef] = {}
 
@@ -89,7 +128,7 @@ class ObjectStore:
                 seg.buf[: flat.nbytes] = flat
         finally:
             seg.close()
-        ref = ObjectRef(object_id, view.nbytes, owner, num_rows)
+        ref = ObjectRef(object_id, view.nbytes, owner, num_rows, self.node_id)
         with self._lock:
             self._objects[object_id] = ref
         return ref
@@ -144,7 +183,9 @@ class ObjectStore:
 
     def _set_owner(self, ref: ObjectRef, owner: str) -> ObjectRef:
         with self._lock:
-            new_ref = ObjectRef(ref.object_id, ref.size, owner, ref.num_rows)
+            new_ref = ObjectRef(
+                ref.object_id, ref.size, owner, ref.num_rows, ref.node_id
+            )
             # Adopts the entry even if the object was created by another
             # process in this namespace.
             self._objects[ref.object_id] = new_ref
