@@ -107,6 +107,24 @@ class MLDataset:
                 tables.append(pf.read_row_group(rg, columns=columns))
         return MLDataset(tables, num_shards, shuffle, shuffle_seed)
 
+    def to_df(self):
+        """Back to a DataFrame — the reverse data path (C8 parity with
+        ``ray_dataset_to_spark_dataframe``, reference:
+        python/raydp/spark/dataset.py:506-577). Ref blocks become the
+        frame's partitions with zero copies; in-memory blocks re-enter via
+        the executor's scatter path."""
+        import raydp_tpu.dataframe as rdf
+        from raydp_tpu.context import current_session
+
+        if all(isinstance(b, ObjectRef) for b in self.blocks):
+            session = current_session()
+            if session is not None:
+                return rdf.from_refs(self.blocks)
+        tables = [self._resolve(b) for b in self.blocks]
+        from raydp_tpu.dataframe.io import _distribute
+
+        return _distribute(tables)
+
     # -- introspection --------------------------------------------------
     @property
     def total_rows(self) -> int:

@@ -55,6 +55,7 @@ from raydp_tpu.dataframe.io import (
     from_arrow,
     from_items,
     from_pandas,
+    from_refs,
     range,
     read_csv,
     read_parquet,
@@ -69,6 +70,6 @@ __all__ = [
     "monotonically_increasing_id",
     "Window", "WindowSpec", "asc", "desc",
     "row_number", "rank", "dense_rank", "lag", "lead", "window_sum",
-    "from_arrow", "from_items", "from_pandas", "range",
+    "from_arrow", "from_items", "from_pandas", "from_refs", "range",
     "read_csv", "read_parquet",
 ]

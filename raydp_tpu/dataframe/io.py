@@ -44,6 +44,34 @@ def from_pandas(df, num_partitions: int = 1) -> DataFrame:
     )
 
 
+def from_refs(refs: Sequence[Any]) -> DataFrame:
+    """Build a DataFrame from ObjectRefs already in the session store —
+    the reverse data path (C8): refs/dataset → DataFrame with schema
+    preserved (reference: ray_dataset_to_spark_dataframe,
+    python/raydp/spark/dataset.py:506-577, ObjectStoreReader.scala:32-55).
+
+    Partitions under cluster execution ARE ObjectRefs, so the refs become
+    the frame's partitions directly — no copy; workers resolve them
+    node-locally (or via a store agent) when the next stage runs.
+    """
+    from raydp_tpu.context import current_session
+    from raydp_tpu.dataframe.executor import ClusterExecutor
+    from raydp_tpu.store.object_store import ObjectRef
+
+    refs = list(refs)
+    if not refs:
+        raise ValueError("from_refs needs at least one ref")
+    bad = [r for r in refs if not isinstance(r, ObjectRef)]
+    if bad:
+        raise TypeError(f"from_refs takes ObjectRefs; got {type(bad[0])}")
+    session = current_session()
+    if session is None:
+        raise RuntimeError(
+            "from_refs requires a live session; call raydp_tpu.init() first"
+        )
+    return DataFrame(refs, ClusterExecutor(session.cluster))
+
+
 def from_items(rows: List[Dict[str, Any]], num_partitions: int = 1) -> DataFrame:
     return from_arrow(pa.Table.from_pylist(rows), num_partitions)
 
