@@ -18,7 +18,12 @@ import numpy as np
 import pyarrow as pa
 
 from raydp_tpu.store.object_store import ObjectRef, ObjectStore
-from raydp_tpu.utils.sharding import BlockSlice, divide_blocks
+from raydp_tpu.utils.sharding import (
+    BlockSlice,
+    divide_blocks,
+    divide_blocks_local,
+    locality_fraction,
+)
 
 Block = Union[pa.Table, ObjectRef]
 
@@ -38,6 +43,7 @@ class MLDataset:
         shuffle: bool = False,
         shuffle_seed: Optional[int] = None,
         store: Optional[ObjectStore] = None,
+        rank_nodes: Optional[List[str]] = None,
     ):
         if not blocks:
             raise ValueError("MLDataset needs at least one block")
@@ -52,9 +58,34 @@ class MLDataset:
                 f"{len(blocks)} blocks cannot feed {num_shards} shards; "
                 "repartition the DataFrame first"
             )
-        self.shard_plan: Dict[int, List[BlockSlice]] = divide_blocks(
-            self._block_sizes, num_shards, shuffle, shuffle_seed
-        )
+        # Locality-aware division when the consumer topology is known:
+        # rank_nodes[r] names the node rank r runs on; ref blocks carry
+        # their node, so shard plans keep bytes node-local (reference:
+        # locality-preferring shard selection, dataset.py:411-443).
+        self.block_nodes: List[Optional[str]] = [
+            b.node_id if isinstance(b, ObjectRef) else None for b in blocks
+        ]
+        self.rank_nodes = list(rank_nodes) if rank_nodes is not None else None
+        if self.rank_nodes is not None and any(
+            n is not None for n in self.block_nodes
+        ):
+            nodes = [n or "node-0" for n in self.block_nodes]
+            self.shard_plan: Dict[int, List[BlockSlice]] = divide_blocks_local(
+                self._block_sizes, num_shards, nodes, self.rank_nodes,
+                shuffle, shuffle_seed,
+            )
+        else:
+            self.shard_plan = divide_blocks(
+                self._block_sizes, num_shards, shuffle, shuffle_seed
+            )
+
+    def locality(self) -> Optional[float]:
+        """Fraction of planned samples that are node-local (None when no
+        topology was supplied)."""
+        if self.rank_nodes is None:
+            return None
+        nodes = [n or "node-0" for n in self.block_nodes]
+        return locality_fraction(self.shard_plan, nodes, self.rank_nodes)
 
     # -- constructors ---------------------------------------------------
     @staticmethod
@@ -64,9 +95,13 @@ class MLDataset:
         shuffle: bool = False,
         shuffle_seed: Optional[int] = None,
         owner_transfer: bool = True,
+        rank_nodes: Optional[List[str]] = None,
     ) -> "MLDataset":
         """From a raydp_tpu DataFrame (reference: RayMLDataset.from_spark,
-        dataset.py:283-310). Repartitions up to ``num_shards`` if short."""
+        dataset.py:283-310). Repartitions up to ``num_shards`` if short.
+
+        ``rank_nodes`` (one node id per shard rank) turns on
+        locality-preferring shard assignment."""
         if df.num_partitions < num_shards:
             df = df.repartition(num_shards)
         from raydp_tpu.context import current_session
@@ -77,9 +112,13 @@ class MLDataset:
             # The resolver (not the raw store) so blocks written on any
             # node of a multi-host cluster resolve from the driver.
             store = session.cluster.resolver
-            return MLDataset(refs, num_shards, shuffle, shuffle_seed, store)
+            return MLDataset(
+                refs, num_shards, shuffle, shuffle_seed, store,
+                rank_nodes=rank_nodes,
+            )
         return MLDataset(
-            df.collect_partitions(), num_shards, shuffle, shuffle_seed
+            df.collect_partitions(), num_shards, shuffle, shuffle_seed,
+            rank_nodes=rank_nodes,
         )
 
     @staticmethod

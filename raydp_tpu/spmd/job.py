@@ -157,6 +157,15 @@ class SPMDJob:
         self._gen = 0  # incarnation counter scoping watcher threads
         self._stopping = False
 
+    def rank_nodes(self) -> List[str]:
+        """Node (host) of every rank — ranks fill hosts in order,
+        ``num_procs_per_node`` per host. Feed to
+        ``MLDataset(rank_nodes=...)`` for locality-preferring shard plans."""
+        return [
+            self.hosts[(r // self.num_procs_per_node) % len(self.hosts)]
+            for r in range(self.world_size)
+        ]
+
     # ------------------------------------------------------------------ start
 
     def start(self) -> "SPMDJob":

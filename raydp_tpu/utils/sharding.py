@@ -107,6 +107,129 @@ def divide_blocks(
     return assignment
 
 
+def divide_blocks_local(
+    blocks: Sequence[int],
+    world_size: int,
+    block_nodes: Sequence[str],
+    rank_nodes: Sequence[str],
+    shuffle: bool = False,
+    shuffle_seed: Optional[int] = None,
+) -> Dict[int, List[BlockSlice]]:
+    """Locality-preferring variant of :func:`divide_blocks`.
+
+    Each rank drains blocks living on ITS OWN node before touching remote
+    ones (the reference's locality-preferring shard selection,
+    reference: python/raydp/spark/dataset.py:411-443 to_torch +
+    rdd/RayDatasetRDD.scala:53-55 getPreferredLocations). Invariants are
+    identical to divide_blocks: exactly ``ceil(total/world)`` samples per
+    rank, full coverage, in-bounds slices, deterministic under a seed.
+
+    When data is balanced across nodes proportionally to the ranks on
+    them, every byte stays node-local; imbalance spills the minimum
+    possible remainder to remote ranks.
+    """
+    blocks = list(blocks)
+    if world_size <= 0:
+        raise ValueError("world_size must be positive")
+    if len(rank_nodes) != world_size:
+        raise ValueError("rank_nodes must have world_size entries")
+    if len(block_nodes) != len(blocks):
+        raise ValueError("block_nodes must have one entry per block")
+    if len(blocks) < world_size:
+        raise ValueError(
+            f"not enough blocks ({len(blocks)}) to divide across "
+            f"world_size={world_size}"
+        )
+    total = sum(blocks)
+    if total == 0:
+        raise ValueError("dataset has no rows")
+    samples_per_rank = math.ceil(total / world_size)
+
+    # Per-node pools of (block_index, next_unconsumed_row).
+    pools: Dict[str, List[int]] = {}
+    for i, node in enumerate(block_nodes):
+        pools.setdefault(node, []).append(i)
+    if shuffle:
+        rng = np.random.default_rng(0 if shuffle_seed is None else shuffle_seed)
+        for lst in pools.values():
+            rng.shuffle(lst)
+    consumed = [0] * len(blocks)  # rows of each block already assigned
+
+    def take_from(pool: List[int], want: int, plan: List[BlockSlice]) -> int:
+        """Move up to ``want`` rows out of ``pool`` into ``plan``."""
+        got = 0
+        while pool and got < want:
+            b = pool[0]
+            avail = blocks[b] - consumed[b]
+            if avail <= 0:
+                pool.pop(0)
+                continue
+            n = min(avail, want - got)
+            plan.append(BlockSlice(b, n, consumed[b]))
+            consumed[b] += n
+            got += n
+            if consumed[b] >= blocks[b]:
+                pool.pop(0)
+        return got
+
+    assignment: Dict[int, List[BlockSlice]] = {}
+    for rank in range(world_size):
+        node = rank_nodes[rank]
+        plan: List[BlockSlice] = []
+        need = samples_per_rank
+        need -= take_from(pools.get(node, []), need, plan)
+        # Remote spill: drain the fullest remaining pools first so the
+        # leftover stays balanced for later ranks.
+        while need > 0:
+            candidates = [
+                (n, p) for n, p in pools.items() if p and n != node
+            ] or [(n, p) for n, p in pools.items() if p]
+            if not candidates:
+                break
+            n_, pool = max(
+                candidates,
+                key=lambda np_: sum(
+                    blocks[b] - consumed[b] for b in np_[1]
+                ),
+            )
+            need -= take_from(pool, need, plan)
+        if need > 0:
+            # All rows are assigned; pad by re-reading rows this rank
+            # already holds (or the largest block when its plan is empty —
+            # only possible when every pool drained before this rank).
+            source = [s for s in plan if s.num_samples > 0]
+            if not source:
+                big = int(np.argmax(blocks))
+                source = [
+                    BlockSlice(big, min(samples_per_rank, blocks[big]), 0)
+                ]
+            i = 0
+            while need > 0:
+                s = source[i % len(source)]
+                n = min(need, s.num_samples)
+                plan.append(BlockSlice(s.block_index, n, s.offset))
+                need -= n
+                i += 1
+        assignment[rank] = plan
+    return assignment
+
+
+def locality_fraction(
+    assignment: Dict[int, List[BlockSlice]],
+    block_nodes: Sequence[str],
+    rank_nodes: Sequence[str],
+) -> float:
+    """Fraction of assigned samples that are node-local to their rank."""
+    local = 0
+    total = 0
+    for rank, plan in assignment.items():
+        for s in plan:
+            total += s.num_samples
+            if block_nodes[s.block_index] == rank_nodes[rank]:
+                local += s.num_samples
+    return local / max(1, total)
+
+
 def assignment_sample_counts(
     assignment: Dict[int, List[BlockSlice]],
 ) -> Dict[int, int]:
