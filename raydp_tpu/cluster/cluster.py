@@ -57,6 +57,9 @@ class Cluster:
         self._lock = threading.RLock()
         self._pool = ThreadPoolExecutor(max_workers=32)
         self._resolver = None
+        self._restarts_used = 0
+        self._elastic_stop = threading.Event()
+        self._elastic_thread: Optional[threading.Thread] = None
         self._log_dir = os.path.join(
             "/tmp/raydp_tpu", f"{_slug(config.app_name)}-{os.getpid()}"
         )
@@ -96,6 +99,50 @@ class Cluster:
             self.config.num_workers,
             self.master.address,
         )
+        self._elastic_thread = threading.Thread(
+            target=self._elastic_loop, name="raydp-elastic", daemon=True
+        )
+        self._elastic_thread.start()
+
+    def _elastic_loop(self) -> None:
+        """Crash recovery (reference: executor reschedule on disconnect,
+        RayAppMaster.scala:184-186 + schedule() re-request): a worker
+        process that EXITS without being stopped by us is marked dead and
+        respawned on its node, up to ``max_worker_restarts``. Intentional
+        stops pop the proc from ``_procs`` first, so they never trip this.
+        """
+        while not self._elastic_stop.wait(0.5):
+            with self._lock:
+                exited = [
+                    (wid, proc)
+                    for wid, proc in self._procs.items()
+                    if proc.poll() is not None
+                ]
+            for wid, proc in exited:
+                with self._lock:
+                    if self._procs.get(wid) is not proc:
+                        continue  # stopped/replaced concurrently
+                    self._procs.pop(wid, None)
+                    node = self._worker_nodes.get(wid)
+                    allow = self._restarts_used < self.config.max_worker_restarts
+                    if allow:
+                        self._restarts_used += 1
+                if self.master is None:
+                    return
+                self.master.mark_worker_dead(
+                    wid, reason=f"process exited rc={proc.returncode}"
+                )
+                if allow:
+                    new_id = self._spawn_worker(node_id=node)
+                    logger.warning(
+                        "worker %s crashed (rc=%s); respawned as %s on %s",
+                        wid, proc.returncode, new_id, node,
+                    )
+                else:
+                    logger.error(
+                        "worker %s crashed; restart budget (%d) exhausted",
+                        wid, self.config.max_worker_restarts,
+                    )
 
     def _spawn_agents(self) -> None:
         self._ensure_agents(
@@ -175,10 +222,11 @@ class Cluster:
         bundle = self.pg.bundles[index % len(self.pg.bundles)]
         return bundle.node_id or "node-0"
 
-    def _spawn_worker(self) -> str:
+    def _spawn_worker(self, node_id: Optional[str] = None) -> str:
         seq = next(self._worker_seq)
         worker_id = f"w{seq}"
-        node_id = self._bundle_node(seq)
+        if node_id is None:
+            node_id = self._bundle_node(seq)
         spec = LaunchSpec(
             argv=[
                 "-m",
@@ -215,6 +263,7 @@ class Cluster:
         thread pools are already being torn down by CPython at that point,
         so RPCs to/from the master would race executor shutdown.
         """
+        self._elastic_stop.set()  # teardown must never trigger respawns
         with self._lock:
             worker_ids = list(self._procs)
         if fast:
@@ -279,12 +328,15 @@ class Cluster:
                     proc.kill()
 
     def _stop_worker(self, worker_id: str, kill_objects: bool = True) -> None:
+        # Pop the proc FIRST: once it is out of _procs the elastic loop
+        # cannot mistake this intentional stop for a crash.
+        with self._lock:
+            proc = self._procs.pop(worker_id, None)
         client = self._client_for(worker_id)
         if client is not None:
             client.try_call("Stop", {}, timeout=2.0)
             client.close()
         with self._lock:
-            proc = self._procs.pop(worker_id, None)
             self._worker_clients.pop(worker_id, None)
         if proc is not None:
             if client is None:
@@ -359,9 +411,9 @@ class Cluster:
         *args,
         worker_id: Optional[str] = None,
         timeout: float = 300.0,
+        retries: int = 2,
         **kwargs,
     ) -> Future:
-        target = self._pick_worker(worker_id)
         payload = {
             "fn": cloudpickle.dumps(fn),
             "args": args,
@@ -371,22 +423,50 @@ class Cluster:
         def run():
             import grpc
 
-            client = self._client_for(target)
-            if client is None:
-                raise ClusterError(f"worker {target} is gone")
-            try:
-                reply = client.call("RunTask", payload, timeout=timeout)
-            except grpc.RpcError as exc:
-                code = exc.code()
-                # Only connectivity loss means the worker is gone; a
-                # DEADLINE_EXCEEDED is a slow task on a healthy worker and
-                # must not unlink its objects.
-                if code == grpc.StatusCode.UNAVAILABLE and self.master is not None:
-                    self.master.mark_worker_dead(target, reason="worker unreachable")
-                raise ClusterError(
-                    f"task RPC to worker {target} failed: {code}"
-                ) from exc
-            return reply["result"]
+            preferred = worker_id
+            last: Optional[BaseException] = None
+            for attempt in range(retries + 1):
+                try:
+                    target = self._pick_worker(preferred)
+                except ClusterError as exc:
+                    # Preferred worker gone (or none alive yet — elastic
+                    # respawn may still be bringing one back).
+                    last = exc
+                    preferred = None
+                    time.sleep(0.3 * (attempt + 1))
+                    continue
+                client = self._client_for(target)
+                if client is None:
+                    preferred = None
+                    last = ClusterError(f"worker {target} is gone")
+                    continue
+                try:
+                    reply = client.call("RunTask", payload, timeout=timeout)
+                    return reply["result"]
+                except grpc.RpcError as exc:
+                    code = exc.code()
+                    # Only connectivity loss means the worker is gone and
+                    # the task is retriable elsewhere; a DEADLINE_EXCEEDED
+                    # is a slow task on a healthy worker and must not
+                    # unlink its objects or re-run the work.
+                    if (
+                        code == grpc.StatusCode.UNAVAILABLE
+                        and self.master is not None
+                    ):
+                        self.master.mark_worker_dead(
+                            target, reason="worker unreachable"
+                        )
+                        last = ClusterError(
+                            f"task RPC to worker {target} failed: {code}"
+                        )
+                        preferred = None
+                        continue  # idempotent stage task: retry elsewhere
+                    raise ClusterError(
+                        f"task RPC to worker {target} failed: {code}"
+                    ) from exc
+            raise ClusterError(
+                f"task failed after {retries + 1} attempts: {last}"
+            ) from last
 
         return self._pool.submit(run)
 

@@ -34,6 +34,10 @@ class ClusterConfig:
     placement_group: Optional[Any] = None  # pre-created PlacementGroup
     placement_bundle_indexes: Optional[list] = None
     enable_native: bool = True  # use the C++ data-plane library when built
+    # -- elasticity ----------------------------------------------------
+    # Crash-respawn budget for ETL workers (reference: executor
+    # reschedule on disconnect, RayAppMaster.scala:184-186 + schedule()).
+    max_worker_restarts: int = 3
     # -- multi-host ----------------------------------------------------
     num_virtual_nodes: int = 0  # >1: simulate N hosts on this machine
     bind_host: str = "127.0.0.1"  # "0.0.0.0" for real cross-host clusters
@@ -51,6 +55,7 @@ class ClusterConfig:
         placement_group: Optional[Any] = None,
         placement_bundle_indexes: Optional[list] = None,
         enable_native: bool = True,
+        max_worker_restarts: int = 3,
         num_virtual_nodes: int = 0,
         bind_host: str = "127.0.0.1",
         advertise_host: Optional[str] = None,
@@ -66,6 +71,7 @@ class ClusterConfig:
             placement_group=placement_group,
             placement_bundle_indexes=placement_bundle_indexes,
             enable_native=enable_native,
+            max_worker_restarts=max_worker_restarts,
             num_virtual_nodes=num_virtual_nodes,
             bind_host=bind_host,
             advertise_host=advertise_host,

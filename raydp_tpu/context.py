@@ -63,6 +63,7 @@ def init(
     placement_group: Optional[Any] = None,
     placement_bundle_indexes: Optional[list] = None,
     enable_native: bool = True,
+    max_worker_restarts: int = 3,
     num_virtual_nodes: int = 0,
     bind_host: str = "127.0.0.1",
     advertise_host: Optional[str] = None,
@@ -92,6 +93,7 @@ def init(
             placement_group=placement_group,
             placement_bundle_indexes=placement_bundle_indexes,
             enable_native=enable_native,
+            max_worker_restarts=max_worker_restarts,
             num_virtual_nodes=num_virtual_nodes,
             bind_host=bind_host,
             advertise_host=advertise_host,

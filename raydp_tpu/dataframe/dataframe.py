@@ -70,6 +70,12 @@ class DataFrame:
         out._exchange_keys = self._exchange_keys  # rows did not move
         return out
 
+    def mapPartitions(self, fn: Callable[[pa.Table], pa.Table]) -> "DataFrame":
+        """Arbitrary per-partition Arrow transform — the escape hatch the
+        reference gets from mapInPandas (reference:
+        python/raydp/spark/dataset.py:520-534)."""
+        return self._with(fn)
+
     # -- narrow ops -----------------------------------------------------
     def _apply_expr_stage(
         self,

@@ -155,7 +155,7 @@ class ClusterExecutor(Executor):
     def map_partitions(self, parts, fn):
         def task(ctx, ref):
             table = ctx.get_table(ref)
-            return ctx.put_table(fn(table))
+            return ctx.put_table(fn(table), holder=True)
 
         futures = [
             self.cluster.submit_async(
@@ -168,7 +168,7 @@ class ClusterExecutor(Executor):
     def map_partitions_indexed(self, parts, fn):
         def task(ctx, ref, index):
             table = ctx.get_table(ref)
-            return ctx.put_table(fn(table, index))
+            return ctx.put_table(fn(table, index), holder=True)
 
         futures = [
             self.cluster.submit_async(task, ref, i,
@@ -180,7 +180,7 @@ class ClusterExecutor(Executor):
     def exchange(self, parts, splitter, n_out, combine=None):
         def split_task(ctx, ref):
             table = ctx.get_table(ref)
-            return [ctx.put_table(chunk) for chunk in splitter(table)]
+            return [ctx.put_table(chunk, holder=True) for chunk in splitter(table)]
 
         futures = [
             self.cluster.submit_async(split_task, ref,
@@ -194,7 +194,7 @@ class ClusterExecutor(Executor):
             merged = _concat(tables)
             if combine is not None:
                 merged = combine(merged)
-            return ctx.put_table(merged)
+            return ctx.put_table(merged, holder=True)
 
         merge_futures = [
             self.cluster.submit_async(

@@ -1,0 +1,100 @@
+"""Elastic recovery: crash respawn + map-task re-run (VERDICT r1 item 7).
+
+Reference behavior being matched: executor kill-and-reschedule on RPC
+disconnect (RayAppMaster.scala:184-186 + schedule()) and Ray Train's
+max_retries (torch/estimator.py:269).
+"""
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import raydp_tpu
+import raydp_tpu.dataframe as rdf
+
+
+def _wait(predicate, timeout=15.0, interval=0.2):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+def test_crash_respawns_worker_on_same_node():
+    s = raydp_tpu.init(app_name="elastic-respawn", num_workers=2)
+    try:
+        first = {w.worker_id for w in s.cluster.alive_workers()}
+        victim = sorted(first)[0]
+        node = s.cluster._worker_nodes[victim]
+        s.cluster._procs[victim].kill()  # SIGKILL: a real crash
+        assert _wait(
+            lambda: len(s.cluster.alive_workers()) == 2
+            and victim
+            not in {w.worker_id for w in s.cluster.alive_workers()}
+        ), "worker was not respawned"
+        replacement = [
+            w for w in s.cluster.alive_workers() if w.worker_id not in first
+        ]
+        assert replacement and replacement[0].node_id == node
+        # the refreshed pool is usable
+        out = rdf.from_pandas(
+            pd.DataFrame({"x": range(100)}), num_partitions=2
+        ).withColumn("x2", rdf.col("x") * 2).to_pandas()
+        assert out["x2"].sum() == 2 * sum(range(100))
+    finally:
+        raydp_tpu.stop()
+
+
+def test_restart_budget_exhausted_no_respawn():
+    s = raydp_tpu.init(
+        app_name="elastic-budget", num_workers=2, max_worker_restarts=0
+    )
+    try:
+        victim = sorted(w.worker_id for w in s.cluster.alive_workers())[0]
+        s.cluster._procs[victim].kill()
+        assert _wait(lambda: len(s.cluster.alive_workers()) == 1, timeout=8)
+        time.sleep(1.5)  # no respawn sneaks in afterwards
+        assert len(s.cluster.alive_workers()) == 1
+    finally:
+        raydp_tpu.stop()
+
+
+def test_map_partitions_completes_despite_worker_kill():
+    """The VERDICT 'done =' test: kill a worker mid-map_partitions, the
+    job still completes (inputs are holder-owned, tasks retry elsewhere)."""
+    s = raydp_tpu.init(app_name="elastic-retry", num_workers=3)
+    try:
+        pdf = pd.DataFrame({"x": np.arange(6000)})
+        df = rdf.from_pandas(pdf, num_partitions=6)
+
+        def slow_stage(t):
+            import time as _t
+
+            _t.sleep(0.8)
+            import pyarrow.compute as pc
+
+            return t.set_column(
+                0, "x", pc.multiply(t.column("x"), 3)
+            )
+
+        result = {}
+
+        def run():
+            result["df"] = df.mapPartitions(slow_stage).to_pandas()
+
+        worker = threading.Thread(target=run)
+        worker.start()
+        time.sleep(0.4)  # tasks are now in flight
+        victim = sorted(s.cluster._procs)[0]
+        s.cluster._procs[victim].kill()
+        worker.join(timeout=90)
+        assert not worker.is_alive(), "pipeline hung after worker kill"
+        out = result["df"].sort_values("x").reset_index(drop=True)
+        assert len(out) == 6000
+        assert out["x"].tolist() == (pdf["x"] * 3).tolist()
+    finally:
+        raydp_tpu.stop()
