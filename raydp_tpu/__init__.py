@@ -17,6 +17,6 @@ with RayDP (reference mounted at /root/reference) with a TPU-first design:
 """
 from raydp_tpu.version import __version__
 
-from raydp_tpu.context import init, stop  # noqa: E402
+from raydp_tpu.context import connect, init, stop  # noqa: E402
 
-__all__ = ["__version__", "init", "stop"]
+__all__ = ["__version__", "connect", "init", "stop"]

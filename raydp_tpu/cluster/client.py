@@ -1,0 +1,277 @@
+"""Client mode: a second driver attaching to a live AppMaster.
+
+Parity with the reference's Ray-client story, where every test runs both
+direct and through ``ray://`` (reference: python/raydp/tests/
+conftest.py:42-49) and a driver can live inside another process
+(test_spark_cluster.py:38-57). Here the whole control plane is already
+gRPC, so a remote driver is a set of thin proxies:
+
+  * object writes → ``PutObject`` on the master (driver-node store);
+  * object reads  → the standard resolver (master directory → node agent
+    fetch; the client has no shm of its own, so every read is remote);
+  * stage tasks   → shipped straight to workers' RunTask endpoints, with
+    the same retry discipline as the in-process Cluster;
+  * lifecycle RPCs (ListWorkers, ClusterResources, TransferToHolder…) →
+    the master service.
+
+``raydp_tpu.connect(addr)`` installs a ClientSession as the process
+session, so the whole DataFrame/MLDataset/estimator surface works
+unchanged. Disconnecting never tears the remote cluster down.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional
+
+import cloudpickle
+import pyarrow as pa
+
+from raydp_tpu.cluster.master import SERVICE, WorkerInfo
+from raydp_tpu.cluster.rpc import RpcClient, RpcError
+from raydp_tpu.store.object_store import OWNER_HOLDER, ObjectRef
+from raydp_tpu.store.resolver import ObjectResolver
+
+logger = logging.getLogger(__name__)
+
+
+class ClientError(RuntimeError):
+    pass
+
+
+class _RemoteStore:
+    """Duck-types the DirectoryStore surface the executor layer uses,
+    proxying every operation to the master."""
+
+    def __init__(self, master: RpcClient, namespace: str):
+        self.namespace = namespace
+        self.node_id = f"client-{os.getpid()}"  # never matches a data node
+        self._master = master
+
+    def put(self, data, owner: str = OWNER_HOLDER, num_rows: int = -1) -> ObjectRef:
+        reply = self._master.call(
+            "PutObject",
+            {"data": bytes(data), "owner": owner, "num_rows": num_rows},
+            timeout=120.0,
+        )
+        return reply["ref"]
+
+    def put_arrow_table(self, table: pa.Table, owner: str = OWNER_HOLDER) -> ObjectRef:
+        sink = pa.BufferOutputStream()
+        with pa.ipc.new_stream(sink, table.schema) as writer:
+            writer.write_table(table)
+        return self.put(
+            sink.getvalue().to_pybytes(), owner=owner, num_rows=table.num_rows
+        )
+
+    def get_ref(self, object_id: str) -> Optional[ObjectRef]:
+        reply = self._master.call("GetObjectMeta", {"object_id": object_id})
+        return reply.get("ref")
+
+    def transfer_to_holder(self, ref: ObjectRef) -> ObjectRef:
+        return self._master.call("TransferToHolder", {"ref": ref})["ref"]
+
+    def delete(self, ref_or_id) -> bool:
+        object_id = (
+            ref_or_id.object_id
+            if isinstance(ref_or_id, ObjectRef)
+            else ref_or_id
+        )
+        reply = self._master.call("DeleteObject", {"object_id": object_id})
+        return bool(reply.get("deleted"))
+
+    def contains(self, ref_or_id) -> bool:
+        object_id = (
+            ref_or_id.object_id
+            if isinstance(ref_or_id, ObjectRef)
+            else ref_or_id
+        )
+        return self.get_ref(object_id) is not None
+
+    def refs(self) -> List[ObjectRef]:
+        return self._master.call("ListObjects", {})["refs"]
+
+    # Resolver local-store protocol: the client holds no segments.
+    def get_buffer(self, ref_or_id):
+        raise KeyError("client has no local segments")
+
+    def get_bytes(self, ref_or_id):
+        raise KeyError("client has no local segments")
+
+    def get_arrow_table(self, ref_or_id):
+        raise KeyError("client has no local segments")
+
+
+class _RemoteMaster:
+    """The ``cluster.master`` facet a client sees."""
+
+    def __init__(self, client: RpcClient, namespace: str):
+        self._client = client
+        self.namespace = namespace
+        self.store = _RemoteStore(client, namespace)
+
+    def object_meta(self, object_id: str):
+        reply = self._client.call("GetObjectMeta", {"object_id": object_id})
+        return reply.get("ref"), reply.get("agent")
+
+    def alive_workers(self) -> List[WorkerInfo]:
+        workers = self._client.call("ListWorkers", {})["workers"]
+        return [w for w in workers if w.state == "ALIVE"]
+
+    def cluster_resources(self) -> dict:
+        return self._client.call("ClusterResources", {})
+
+    def mark_worker_dead(self, worker_id: str, reason: str = "") -> None:
+        # Best-effort: the real master's own monitors are authoritative;
+        # a client merely stops routing to the worker.
+        logger.warning("client: worker %s unreachable (%s)", worker_id, reason)
+
+
+class RemoteCluster:
+    """Duck-types the Cluster surface used by executors/datasets."""
+
+    _WORKER_TTL = 1.0  # seconds of ListWorkers caching
+
+    def __init__(self, master_address: str):
+        self.master_address = master_address
+        self._client = RpcClient(master_address, SERVICE)
+        reply = self._client.call("Ping", {})
+        self.namespace = reply["namespace"]
+        self.master = _RemoteMaster(self._client, self.namespace)
+        self._pool = ThreadPoolExecutor(max_workers=32)
+        self._worker_clients: Dict[str, RpcClient] = {}
+        self._workers_cache: List[WorkerInfo] = []
+        self._workers_stamp = 0.0
+        self._lock = threading.RLock()
+        self._resolver: Optional[ObjectResolver] = None
+
+    # -- object access --------------------------------------------------
+    @property
+    def resolver(self) -> ObjectResolver:
+        if self._resolver is None:
+            self._resolver = ObjectResolver(
+                self.master.store, self.master.object_meta
+            )
+        return self._resolver
+
+    # -- introspection --------------------------------------------------
+    def alive_workers(self) -> List[WorkerInfo]:
+        now = time.monotonic()
+        with self._lock:
+            if now - self._workers_stamp < self._WORKER_TTL:
+                return list(self._workers_cache)
+        workers = self.master.alive_workers()
+        with self._lock:
+            self._workers_cache = workers
+            self._workers_stamp = now
+        return list(workers)
+
+    def cluster_resources(self) -> dict:
+        return self.master.cluster_resources()
+
+    # -- task submission ------------------------------------------------
+    def submit(self, fn, *args, worker_id=None, timeout=300.0, **kwargs):
+        return self.submit_async(
+            fn, *args, worker_id=worker_id, timeout=timeout, **kwargs
+        ).result()
+
+    def submit_async(
+        self,
+        fn: Callable,
+        *args,
+        worker_id: Optional[str] = None,
+        timeout: float = 300.0,
+        retries: int = 2,
+        **kwargs,
+    ) -> Future:
+        payload = {
+            "fn": cloudpickle.dumps(fn),
+            "args": args,
+            "kwargs": kwargs,
+        }
+
+        def run():
+            import grpc
+
+            preferred = worker_id
+            last: Optional[BaseException] = None
+            for attempt in range(retries + 1):
+                workers = self.alive_workers()
+                target = None
+                if preferred is not None:
+                    target = next(
+                        (w for w in workers if w.worker_id == preferred), None
+                    )
+                if target is None:
+                    if not workers:
+                        last = ClientError("no alive workers")
+                        time.sleep(0.3 * (attempt + 1))
+                        continue
+                    target = workers[attempt % len(workers)]
+                client = self._worker_client(target)
+                try:
+                    reply = client.call("RunTask", payload, timeout=timeout)
+                    return reply["result"]
+                except grpc.RpcError as exc:
+                    code = exc.code()
+                    if code == grpc.StatusCode.UNAVAILABLE:
+                        with self._lock:
+                            self._workers_stamp = 0.0  # force refresh
+                        preferred = None
+                        last = ClientError(
+                            f"worker {target.worker_id} unreachable"
+                        )
+                        continue
+                    raise ClientError(
+                        f"task RPC to {target.worker_id} failed: {code}"
+                    ) from exc
+            raise ClientError(
+                f"task failed after {retries + 1} attempts: {last}"
+            ) from last
+
+        return self._pool.submit(run)
+
+    def _worker_client(self, info: WorkerInfo) -> RpcClient:
+        with self._lock:
+            client = self._worker_clients.get(info.worker_id)
+            if client is None or client.address != info.address:
+                client = RpcClient(info.address, "raydp.Worker")
+                self._worker_clients[info.worker_id] = client
+            return client
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False)
+        with self._lock:
+            for client in self._worker_clients.values():
+                client.close()
+            self._worker_clients.clear()
+        if self._resolver is not None:
+            self._resolver.close()
+        self._client.close()
+
+
+class ClientSession:
+    """Session facade for a remote driver. ``stop()`` disconnects only —
+    the cluster belongs to the process that ran ``init()``."""
+
+    # context.init() inspects this when replacing a stopped session; a
+    # client never owns holder objects, so it is always "released".
+    _holder_released = True
+
+    def __init__(self, master_address: str):
+        self.cluster = RemoteCluster(master_address)
+        self._closed = False
+
+    @property
+    def stopped(self) -> bool:
+        return self._closed
+
+    def stop(self, del_obj_holder: bool = True, fast: bool = False) -> None:
+        if not self._closed:
+            self.cluster.close()
+            self._closed = True
+
+    disconnect = stop

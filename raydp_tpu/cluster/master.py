@@ -70,6 +70,7 @@ class AppMaster:
             "Heartbeat": self._on_heartbeat,
             "WorkerStopped": self._on_worker_stopped,
             "RegisterObject": self._on_register_object,
+            "PutObject": self._on_put_object,
             "RegisterAgent": self._on_register_agent,
             "TransferToHolder": self._on_transfer_to_holder,
             "GetObjectMeta": self._on_get_object_meta,
@@ -203,6 +204,16 @@ class AppMaster:
     def _on_register_object(self, req: dict) -> dict:
         self.store.register_ref(req["ref"])
         return {}
+
+    def _on_put_object(self, req: dict) -> dict:
+        """Remote-driver write path (client mode): bytes land in the
+        driver node's store under the requested owner."""
+        ref = self.store.put(
+            req["data"],
+            owner=req.get("owner", OWNER_HOLDER),
+            num_rows=req.get("num_rows", -1),
+        )
+        return {"ref": ref}
 
     def _on_register_agent(self, req: dict) -> dict:
         self.store.register_agent(

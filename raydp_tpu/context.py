@@ -104,6 +104,25 @@ def init(
         return _session
 
 
+def connect(master_address: str) -> "Session":
+    """Attach THIS process as a remote driver to a live AppMaster
+    (client mode — reference: every test runs under ``ray://`` too,
+    conftest.py:42-49). The DataFrame/MLDataset/estimator surface works
+    unchanged; ``stop()`` merely disconnects."""
+    global _session
+    with _lock:
+        if _session is not None and not _session.stopped:
+            raise RuntimeError(
+                "a raydp_tpu session is already active in this process; "
+                "call raydp_tpu.stop() first"
+            )
+        from raydp_tpu.cluster.client import ClientSession
+
+        session = ClientSession(master_address)
+        _session = session
+        return session
+
+
 def stop(del_obj_holder: bool = True) -> None:
     """Stop the session. With ``del_obj_holder=False`` the object-store
     holder keeps owned objects alive for later reads."""
