@@ -102,7 +102,8 @@ class DataConfig:
 
 @dataclass
 class TrainConfig:
-    """Estimator training-loop settings."""
+    """Estimator training-loop settings (consumed by ``JAXEstimator``:
+    pass as ``train_config=`` and its values override the scalar kwargs)."""
 
     num_epochs: int = 1
     mesh: MeshSpec = field(default_factory=MeshSpec)
@@ -111,10 +112,13 @@ class TrainConfig:
     checkpoint_dir: Optional[str] = None
     max_failures: int = 3  # step-level retry budget (parity with Ray Train's
     # max_retries; reference: python/raydp/torch/estimator.py:269)
+    save_every_steps: int = 0  # >0: mid-epoch checkpoints w/ data position
 
     def __post_init__(self):
         if self.num_epochs <= 0:
             raise ValueError("num_epochs must be positive")
+        if self.save_every_steps < 0:
+            raise ValueError("save_every_steps must be >= 0")
 
 
 def validate_config(cfg: ClusterConfig) -> None:

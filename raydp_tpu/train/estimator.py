@@ -78,7 +78,28 @@ class JAXEstimator:
         scan_threshold_bytes: int = 2 << 30,
         shard_params: bool = True,
         logical_rules: Optional[Sequence] = None,
+        max_failures: int = 3,
+        save_every_steps: int = 0,
+        prefetch: int = 2,
+        drop_last: bool = False,
+        train_config: Optional[Any] = None,
+        data_config: Optional[Any] = None,
     ):
+        # Typed-config forms (SURVEY §5.6): values in a supplied
+        # TrainConfig/DataConfig override the corresponding scalar kwargs.
+        if train_config is not None:
+            num_epochs = train_config.num_epochs
+            mesh = train_config.mesh
+            seed = train_config.seed
+            log_every = train_config.log_every_steps
+            checkpoint_dir = train_config.checkpoint_dir
+            max_failures = train_config.max_failures
+            save_every_steps = train_config.save_every_steps
+        if data_config is not None:
+            batch_size = data_config.batch_size
+            shuffle = data_config.shuffle
+            prefetch = data_config.prefetch
+            drop_last = data_config.drop_last
         self._model = model() if callable(model) and not _is_module(model) else model
         if optimizer is None:
             optimizer = optax.adam(1e-3)
@@ -113,6 +134,10 @@ class JAXEstimator:
             )
         self.epoch_mode = epoch_mode
         self.scan_threshold_bytes = scan_threshold_bytes
+        self.max_failures = max_failures
+        self.save_every_steps = save_every_steps
+        self.prefetch = prefetch
+        self.drop_last = drop_last
         # Model-parallel wiring: when the model carries flax logical-axis
         # metadata (all transformer/DLRM models in this repo do), state is
         # initialized SHARDED over the mesh per ``logical_rules`` — tp/sp
@@ -128,6 +153,7 @@ class JAXEstimator:
         self._mesh = None
         self._state: Optional[TrainState] = None
         self._state_shardings = None
+        self._resume_position = None
         self._train_step = None
         self._eval_step = None
         self.history: List[Dict[str, float]] = []
@@ -295,13 +321,20 @@ class JAXEstimator:
         train_ds: MLDataset,
         evaluate_ds: Optional[MLDataset] = None,
         num_epochs: Optional[int] = None,
+        resume_from: Optional[str] = None,
     ) -> List[Dict[str, float]]:
+        """Train. ``resume_from`` names a checkpoint path (as returned by
+        :meth:`save`); when it carries a mid-epoch data position
+        (``save_every_steps`` checkpoints do), training continues from
+        exactly that (epoch, batch) — the per-epoch shuffle is
+        deterministic and the dropout rng chain is fast-forwarded, so a
+        resumed run reproduces the uninterrupted one (SURVEY §5.4)."""
         if self.feature_columns is None or self.label_column is None:
             raise ValueError(
                 "feature_columns and label_column must be configured"
             )
         epochs = num_epochs if num_epochs is not None else self.num_epochs
-        if self._use_scan(train_ds):
+        if self._use_scan(train_ds) and resume_from is None:
             return self._fit_scan(train_ds, evaluate_ds, epochs)
         # One loader per shard: a multi-shard dataset is consumed in full
         # (shards chained within each epoch), never silently truncated to
@@ -316,30 +349,86 @@ class JAXEstimator:
                 seed=self.seed,
                 feature_dtype=self.feature_dtype,
                 label_dtype=self.label_dtype,
-                prefetch=2,
+                prefetch=self.prefetch,
                 device=None,  # estimator does the (sharded) device_put
+                drop_last=self.drop_last,
             )
             for rank in range(train_ds.num_shards)
         ]
         rng = jax.random.PRNGKey(self.seed + 1)
-        for epoch in range(epochs):
+        start_epoch, skip_batches = 0, 0
+        if resume_from is not None:
+            cols = train_ds.shard_columns(0, list(self.feature_columns))
+            sample_x = np.stack(
+                [
+                    cols[c][:1].astype(self.feature_dtype, copy=False)
+                    for c in self.feature_columns
+                ],
+                axis=1,
+            )
+            self.restore_path(resume_from, sample_x=sample_x)
+            if self._resume_position is not None:
+                start_epoch, skip_batches = self._resume_position
+            # Fast-forward the dropout rng chain: one split per completed
+            # optimizer step, exactly as the uninterrupted run consumed it.
+            for _ in range(int(self._state.step)):
+                rng, _ = jax.random.split(rng)
+        steps_done = int(self._state.step) if self._state is not None else 0
+        failures = 0
+        for epoch in range(start_epoch, epochs):
             t0 = time.perf_counter()
+            for loader in loaders:
+                loader.set_epoch(epoch)
             # Accumulate the loss ON DEVICE: a float() per step would sync
             # host↔device and serialize the prefetch/double-buffer pipeline.
             loss_sum = None
             n_batches, n_samples = 0, 0
+            b_idx = 0
+            to_skip = skip_batches if epoch == start_epoch else 0
             for loader in loaders:
                 for x, y in loader:
+                    if b_idx < to_skip:
+                        b_idx += 1
+                        continue
                     if self._state is None:
                         self._init_state(x)
                     rng, step_rng = jax.random.split(rng)
                     xd, yd = self._shard_batch(x, y)
-                    self._state, loss_val = self._train_step(
-                        self._state, xd, yd, step_rng
-                    )
+                    while True:
+                        try:
+                            self._state, loss_val = self._train_step(
+                                self._state, xd, yd, step_rng
+                            )
+                            break
+                        except Exception:
+                            # Step-level retry budget
+                            # (TrainConfig.max_failures; reference: Ray
+                            # Train max_retries, torch/estimator.py:269).
+                            # Transient device/runtime errors re-run the
+                            # same batch; persistent ones exhaust the
+                            # budget and surface.
+                            failures += 1
+                            if failures > self.max_failures:
+                                raise
+                            logger.warning(
+                                "train step failed (%d/%d); retrying batch",
+                                failures, self.max_failures, exc_info=True,
+                            )
                     loss_sum = loss_val if loss_sum is None else loss_sum + loss_val
                     n_batches += 1
+                    b_idx += 1
+                    steps_done += 1
                     n_samples += len(x)
+                    if (
+                        self.save_every_steps
+                        and self.checkpoint_dir
+                        and steps_done % self.save_every_steps == 0
+                    ):
+                        self.save(
+                            self.checkpoint_dir,
+                            step=f"mid_{steps_done}",
+                            data_position=(epoch, b_idx),
+                        )
                     if self.log_every and n_batches % self.log_every == 0:
                         logger.info(
                             "epoch %d step %d loss %.5f",
@@ -582,14 +671,21 @@ class JAXEstimator:
         preds = jax.device_get(self._state.apply_fn(self._state.params, xd))
         return np.asarray(preds)[: len(x)]
 
-    def save(self, checkpoint_dir: str, step: Optional[int] = None) -> str:
+    def save(
+        self,
+        checkpoint_dir: str,
+        step=None,
+        data_position: Optional[tuple] = None,
+    ) -> str:
         """Orbax sharded checkpoint (reference: save→Trainer.save,
-        estimator.py:46-51)."""
+        estimator.py:46-51). ``data_position=(epoch, batch)`` records the
+        dataset position for mid-epoch resume (SURVEY §5.4)."""
         import orbax.checkpoint as ocp
 
         if self._state is None:
             raise RuntimeError("nothing to save; call fit() first")
         path = _ckpt_path(checkpoint_dir, step)
+        epoch, batch = data_position if data_position is not None else (-1, -1)
         ckptr = ocp.StandardCheckpointer()
         ckptr.save(
             path,
@@ -597,17 +693,26 @@ class JAXEstimator:
                 "params": jax.device_get(self._state.params),
                 "opt_state": jax.device_get(self._state.opt_state),
                 "step": jax.device_get(self._state.step),
+                "data_epoch": np.asarray(epoch, dtype=np.int64),
+                "data_batch": np.asarray(batch, dtype=np.int64),
             },
             force=True,
         )
         ckptr.wait_until_finished()
         return str(path)
 
-    def restore(self, checkpoint_dir: str, step: Optional[int] = None,
+    def restore(self, checkpoint_dir: str, step=None,
                 sample_x: Optional[np.ndarray] = None) -> None:
         """Restore params/opt state (reference: restore,
         estimator.py:53-58). Needs a sample batch (or prior fit) to build
         the state skeleton."""
+        self.restore_path(
+            str(_ckpt_path(checkpoint_dir, step)), sample_x=sample_x
+        )
+
+    def restore_path(self, path: str,
+                     sample_x: Optional[np.ndarray] = None) -> None:
+        """Restore from an exact checkpoint path (as returned by save())."""
         import orbax.checkpoint as ocp
 
         if self._state is None:
@@ -621,9 +726,20 @@ class JAXEstimator:
             "params": jax.device_get(self._state.params),
             "opt_state": jax.device_get(self._state.opt_state),
             "step": jax.device_get(self._state.step),
+            "data_epoch": np.asarray(0, dtype=np.int64),
+            "data_batch": np.asarray(0, dtype=np.int64),
         }
         ckptr = ocp.StandardCheckpointer()
-        restored = ckptr.restore(_ckpt_path(checkpoint_dir, step), skeleton)
+        try:
+            restored = ckptr.restore(path, skeleton)
+        except BaseException:
+            # Legacy checkpoints (pre data-position) lack the two keys.
+            skeleton.pop("data_epoch")
+            skeleton.pop("data_batch")
+            restored = ckptr.restore(path, skeleton)
+        epoch = int(restored.get("data_epoch", -1))
+        batch = int(restored.get("data_batch", -1))
+        self._resume_position = (epoch, batch) if epoch >= 0 else None
         state = TrainState.create(
             apply_fn=self._model.apply,
             params=restored["params"],

@@ -70,7 +70,16 @@ def _build_optimizer(spec, model, config):
         hyper = {
             k: spec.param_groups[0].get(k, v) for k, v in spec.defaults.items()
         }
-        return spec.__class__(model.parameters(), **hyper)
+        rebound = spec.__class__(model.parameters(), **hyper)
+        # Carry warm-start state (momentum/Adam moments) across the rebind
+        # like the reference's load_state_dict transfer
+        # (torch/estimator.py:164-171); shape mismatches (different model)
+        # fall back to fresh state.
+        try:
+            rebound.load_state_dict(spec.state_dict())
+        except (ValueError, KeyError, RuntimeError):
+            pass
+        return rebound
     if callable(spec):
         return spec(model, config) if _arity(spec) >= 2 else spec(model)
     if spec is None:
@@ -82,7 +91,11 @@ def _build_loss(spec, config):
     import torch
 
     loss_cls = torch.nn.modules.loss._Loss
-    if inspect.isclass(spec) and issubclass(spec, loss_cls):
+    # Any nn.Module subclass is a criterion class (custom losses usually
+    # subclass nn.Module, not the private _Loss) — instantiate with no
+    # args rather than falling through to the creator branch, which would
+    # wrongly pass the config dict to the constructor.
+    if inspect.isclass(spec) and issubclass(spec, torch.nn.Module):
         return spec()
     # Any Module instance is a criterion to use as-is (custom losses
     # usually subclass nn.Module, not the private _Loss).
@@ -134,6 +147,17 @@ def _true_shard_sizes(ds: MLDataset) -> List[int]:
     for n in padded:
         out.append(min(n, max(0, total - seen)))
         seen += n
+    # The clamp above is only correct while divide_blocks places its
+    # wrap-around padding exclusively on TRAILING ranks: once a rank is
+    # clamped short, every later rank must be pure padding (true size 0).
+    first_short = next(
+        (i for i, (n, t) in enumerate(zip(padded, out)) if t < n), None
+    )
+    if first_short is not None:
+        assert all(t == 0 for t in out[first_short + 1:]), (
+            "divide_blocks padding layout changed; _true_shard_sizes "
+            f"misattributes rows: padded={padded} true={out}"
+        )
     return out
 
 

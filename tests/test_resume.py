@@ -1,0 +1,141 @@
+"""Typed-config wiring + mid-epoch resume (VERDICT r1 item 9, SURVEY §5.4).
+
+The resume test is exact: a run interrupted at a mid-epoch checkpoint and
+resumed must reproduce the uninterrupted run's parameters bit-for-bit
+(deterministic per-epoch shuffle + fast-forwarded rng chain).
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+import jax
+import optax
+
+import raydp_tpu.dataframe as rdf
+from raydp_tpu.config import DataConfig, TrainConfig
+from raydp_tpu.data import MLDataset
+from raydp_tpu.models import MLP
+from raydp_tpu.train import JAXEstimator
+
+
+def _ds(n=2048, parts=4, seed=0):
+    rng = np.random.default_rng(seed)
+    a = rng.standard_normal(n)
+    b = rng.standard_normal(n)
+    y = 2 * a - 3 * b + 1
+    df = rdf.from_pandas(
+        pd.DataFrame({"a": a, "b": b, "y": y}), num_partitions=parts
+    )
+    return MLDataset.from_df(df, num_shards=2)
+
+
+def _est(**kw):
+    defaults = dict(
+        model=MLP(hidden=(16,), out_dim=1),
+        optimizer=optax.adam(1e-2),
+        loss="mse",
+        num_epochs=3,
+        batch_size=256,
+        feature_columns=["a", "b"],
+        label_column="y",
+        seed=5,
+        shuffle=True,
+        epoch_mode="stream",
+    )
+    defaults.update(kw)
+    return JAXEstimator(**defaults)
+
+
+def test_train_and_data_config_objects_wire():
+    tc = TrainConfig(num_epochs=2, seed=9, max_failures=1,
+                     log_every_steps=0)
+    dc = DataConfig(batch_size=128, shuffle=False, prefetch=1)
+    est = JAXEstimator(
+        model=MLP(hidden=(8,), out_dim=1),
+        loss="mse",
+        feature_columns=["a", "b"],
+        label_column="y",
+        train_config=tc,
+        data_config=dc,
+    )
+    assert est.num_epochs == 2
+    assert est.seed == 9
+    assert est.batch_size == 128
+    assert est.shuffle is False
+    assert est.max_failures == 1
+    history = est.fit(_ds())
+    assert len(history) == 2
+    assert history[-1]["train_loss"] < history[0]["train_loss"]
+
+
+def test_midepoch_resume_is_exact(tmp_path):
+    ds = _ds()
+    ckpt = str(tmp_path / "ck")
+
+    # Uninterrupted run: 3 epochs.
+    a = _est()
+    a.fit(ds)
+    params_a = jax.device_get(a._state.params)
+
+    # Interrupted run: checkpoints every 3 steps; pretend it died, then a
+    # FRESH estimator resumes from a mid-epoch checkpoint.
+    b1 = _est(checkpoint_dir=ckpt, save_every_steps=3)
+    b1.fit(ds)
+    # pick a checkpoint strictly inside the run (epoch > 0 preferred)
+    import os
+
+    mids = sorted(
+        (p for p in os.listdir(ckpt) if p.startswith("step_mid_")),
+        key=lambda p: int(p.rsplit("_", 1)[1]),
+    )
+    assert mids, "no mid-epoch checkpoints written"
+    middle = mids[len(mids) // 2]
+
+    b2 = _est()
+    b2.fit(ds, resume_from=os.path.join(ckpt, middle))
+    params_b = jax.device_get(b2._state.params)
+
+    flat_a = jax.tree_util.tree_leaves(params_a)
+    flat_b = jax.tree_util.tree_leaves(params_b)
+    for xa, xb in zip(flat_a, flat_b):
+        np.testing.assert_array_equal(xa, xb)
+    assert int(a._state.step) == int(b2._state.step)
+
+
+def test_resume_from_epoch_checkpoint(tmp_path):
+    """Epoch-granularity checkpoints (no data position) resume at the
+    next epoch boundary."""
+    ds = _ds()
+    a = _est(num_epochs=1)
+    a.fit(ds)
+    path = a.save(str(tmp_path / "e0"), data_position=None)
+
+    b = _est(num_epochs=3)
+    b.fit(ds, resume_from=path)
+    # ran epochs 0..2 of its own schedule but with restored state
+    assert len(b.history) == 3
+    assert int(b._state.step) > int(a._state.step)
+
+
+def test_step_retry_budget_surfaces_persistent_failure():
+    est = _est(max_failures=2)
+    ds = _ds()
+
+    calls = {"n": 0}
+
+    class Boom(Exception):
+        pass
+
+    def bad_step(state, x, y, rng):
+        calls["n"] += 1
+        raise Boom("persistent")
+
+    # First batch initializes state, then the train step always fails:
+    # budget of 2 allows 2 failures, the 3rd raises.
+    est._init_state(np.zeros((1, 2), dtype=np.float32))
+    est._train_step = bad_step
+    est._build_steps_real = est._build_steps
+    est._build_steps = lambda: None  # keep the stub in place
+    with pytest.raises(Boom):
+        est.fit(ds)
+    assert calls["n"] >= 3
