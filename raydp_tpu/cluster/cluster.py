@@ -77,6 +77,7 @@ class Cluster:
             nodes=nodes,
             bind_host=self.config.bind_host,
             advertise_host=self.config.advertise_host,
+            port=self.config.master_port,
         )
         try:
             self._place_group()

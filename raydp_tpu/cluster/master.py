@@ -53,6 +53,7 @@ class AppMaster:
         nodes: Optional[List[pl.NodeInfo]] = None,
         bind_host: str = "127.0.0.1",
         advertise_host: Optional[str] = None,
+        port: int = 0,
     ):
         self.namespace = namespace
         self.nodes = nodes if nodes is not None else pl.detect_nodes()
@@ -84,7 +85,8 @@ class AppMaster:
         # process on the node the driver already occupies).
         handlers.update(agent_handlers(self.store))
         self._server = RpcServer(
-            SERVICE, handlers, host=bind_host, advertise_host=advertise_host
+            SERVICE, handlers, host=bind_host, port=port,
+            advertise_host=advertise_host,
         )
         self.store.register_agent(self.node_id, self._server.address, SERVICE)
         self._monitor = threading.Thread(

@@ -42,6 +42,8 @@ class ClusterConfig:
     num_virtual_nodes: int = 0  # >1: simulate N hosts on this machine
     bind_host: str = "127.0.0.1"  # "0.0.0.0" for real cross-host clusters
     advertise_host: Optional[str] = None  # routable addr peers dial
+    master_port: int = 0  # fixed AppMaster port (0 = ephemeral); pods
+    # joining from other hosts need a known port
     launcher: Optional[Any] = None  # WorkerLauncher; default LocalLauncher
     extra: Dict[str, Any] = field(default_factory=dict)
 
@@ -59,6 +61,7 @@ class ClusterConfig:
         num_virtual_nodes: int = 0,
         bind_host: str = "127.0.0.1",
         advertise_host: Optional[str] = None,
+        master_port: int = 0,
         launcher: Optional[Any] = None,
         configs: Optional[Dict[str, Any]] = None,
     ) -> "ClusterConfig":
@@ -75,6 +78,7 @@ class ClusterConfig:
             num_virtual_nodes=num_virtual_nodes,
             bind_host=bind_host,
             advertise_host=advertise_host,
+            master_port=master_port,
             launcher=launcher,
             extra=dict(configs or {}),
         )

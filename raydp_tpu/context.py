@@ -67,6 +67,7 @@ def init(
     num_virtual_nodes: int = 0,
     bind_host: str = "127.0.0.1",
     advertise_host: Optional[str] = None,
+    master_port: int = 0,
     launcher: Optional[Any] = None,
     configs: Optional[Dict[str, Any]] = None,
 ) -> Session:
@@ -97,6 +98,7 @@ def init(
             num_virtual_nodes=num_virtual_nodes,
             bind_host=bind_host,
             advertise_host=advertise_host,
+            master_port=master_port,
             launcher=launcher,
             configs=configs,
         )

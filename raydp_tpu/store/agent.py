@@ -20,7 +20,7 @@ import sys
 import threading
 import time
 import traceback
-from typing import Callable, Dict
+from typing import Callable, Dict, Optional
 
 from raydp_tpu.store.object_store import ObjectStore
 
@@ -55,13 +55,17 @@ def agent_handlers(store: ObjectStore) -> Dict[str, Callable[[dict], dict]]:
 class StoreAgent:
     """Standalone agent process body (non-driver nodes)."""
 
-    def __init__(self, namespace: str, node_id: str, master_address: str,
-                 bind_host: str = "127.0.0.1"):
+    def __init__(self, namespace: Optional[str], node_id: str,
+                 master_address: str, bind_host: str = "127.0.0.1"):
         from raydp_tpu.cluster.rpc import RpcClient, RpcServer
 
         self.node_id = node_id
-        self.store = ObjectStore(namespace=namespace, node_id=node_id)
         self.master = RpcClient(master_address, "raydp.AppMaster")
+        if namespace is None:
+            # Remote pods don't know the session namespace up front —
+            # learn it from the master (Ping carries it).
+            namespace = self.master.call("Ping", {}, timeout=30.0)["namespace"]
+        self.store = ObjectStore(namespace=namespace, node_id=node_id)
         self._stop_event = threading.Event()
         handlers = agent_handlers(self.store)
         handlers["Ping"] = lambda req: {"pong": True, "node_id": node_id}
@@ -122,7 +126,7 @@ class StoreAgent:
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--namespace", required=True)
+    parser.add_argument("--namespace", default=None)
     parser.add_argument("--node-id", required=True)
     parser.add_argument("--master", required=True)
     parser.add_argument("--bind-host", default="127.0.0.1")
