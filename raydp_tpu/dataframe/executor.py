@@ -67,6 +67,10 @@ class Executor:
         WITHOUT materializing partitions on the driver (range-sort pivots)."""
         raise NotImplementedError
 
+    def default_fanout(self) -> int:
+        """How many output partitions a shuffle should target."""
+        return 8
+
 
 def _concat(tables: List[pa.Table]) -> pa.Table:
     tables = [t for t in tables if t is not None]
@@ -116,6 +120,9 @@ class LocalExecutor(Executor):
         return [
             vals for t in parts for vals in [_sample_table(t, column, k)]
         ]
+
+    def default_fanout(self) -> int:
+        return min(8, (os.cpu_count() or 2) * 2)
 
 
 def _sample_table(t: pa.Table, column: str, k: int) -> list:
@@ -246,6 +253,11 @@ class ClusterExecutor(Executor):
 
     def num_rows(self, part):
         return part.num_rows if isinstance(part, ObjectRef) else -1
+
+    def default_fanout(self) -> int:
+        # 2 shuffle partitions per alive worker keeps every worker busy in
+        # the merge phase and scales with dynamic allocation (no hard cap).
+        return max(8, 2 * len(self.cluster.alive_workers()))
 
     def sample_column(self, parts, column, k):
         def task(ctx, ref):

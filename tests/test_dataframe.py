@@ -322,3 +322,74 @@ def test_select_duplicate_names_rejected():
     df = rdf.from_items([{"x": 1}])
     with pytest.raises(ValueError, match="duplicate"):
         df.select("x", (col("x") + 1).alias("x"))
+
+
+def test_agg_stddev_variance_matches_pandas():
+    import numpy as np
+    import pandas as pd
+
+    rng = np.random.default_rng(5)
+    pdf = pd.DataFrame(
+        {"k": rng.integers(0, 4, 500), "v": rng.standard_normal(500) * 3}
+    )
+    out = (
+        rdf.from_pandas(pdf, num_partitions=4)
+        .groupBy("k")
+        .agg({"v": "stddev"}, ("v", "variance"))
+        .to_pandas()
+        .sort_values("k")
+        .reset_index(drop=True)
+    )
+    exp = pdf.groupby("k")["v"].agg(["std", "var"]).reset_index()
+    assert np.allclose(out["stddev(v)"], exp["std"])
+    assert np.allclose(out["variance(v)"], exp["var"])
+
+
+def test_agg_first_last_and_count_distinct():
+    import numpy as np
+    import pandas as pd
+
+    pdf = pd.DataFrame(
+        {
+            "k": [0, 0, 0, 1, 1, 2],
+            "v": [10, 10, 20, 30, 30, 40],
+        }
+    )
+    out = (
+        rdf.from_pandas(pdf, num_partitions=3)
+        .groupBy("k")
+        .agg({"v": "count_distinct"})
+        .to_pandas()
+        .sort_values("k")
+        .reset_index(drop=True)
+    )
+    exp = pdf.groupby("k")["v"].nunique().reset_index()
+    assert out["count_distinct(v)"].tolist() == exp["v"].tolist()
+
+    first = (
+        rdf.from_pandas(pdf, num_partitions=1)
+        .groupBy("k")
+        .agg({"v": "first"}, ("v", "last"))
+        .to_pandas()
+        .sort_values("k")
+        .reset_index(drop=True)
+    )
+    assert first["first(v)"].tolist() == [10, 30, 40]
+    assert first["last(v)"].tolist() == [20, 30, 40]
+
+
+def test_agg_fanout_scales_beyond_old_cap():
+    import numpy as np
+    import pandas as pd
+
+    rng = np.random.default_rng(6)
+    pdf = pd.DataFrame(
+        {"k": rng.integers(0, 100, 5000), "v": rng.standard_normal(5000)}
+    )
+    df = rdf.from_pandas(pdf, num_partitions=16)
+    agg = df.groupBy("k").agg({"v": "sum"})
+    out = agg.to_pandas().sort_values("k").reset_index(drop=True)
+    exp = pdf.groupby("k", as_index=False)["v"].sum()
+    assert np.allclose(out["sum(v)"].to_numpy(), exp["v"].to_numpy())
+    # fan-out followed the executor's default, not the old hard cap of 8
+    assert agg.num_partitions > 8 or df._executor.default_fanout() <= 8
