@@ -1,10 +1,12 @@
 from raydp_tpu.train.estimator import JAXEstimator, TrainingCallback
 from raydp_tpu.train.losses import LOSSES, METRICS, resolve_loss, resolve_metric
+from raydp_tpu.train.tf_estimator import TFEstimator
 from raydp_tpu.train.torch_estimator import TorchEstimator
 
 __all__ = [
     "JAXEstimator",
     "TorchEstimator",
+    "TFEstimator",
     "TrainingCallback",
     "LOSSES",
     "METRICS",
