@@ -193,7 +193,7 @@ class DataFrame:
             if t.num_rows == 0:
                 return [t] * n_out
             bucket = _hash_bucket(t, keys, n_out)
-            return [t.filter(pa.array(bucket == i)) for i in range(n_out)]
+            return _split_by_bucket(t, bucket, n_out)
 
         parts = df._executor.exchange(df._parts, splitter, n_out)
         out = DataFrame(parts, df._executor)
@@ -500,10 +500,7 @@ class DataFrame:
             bucket = np.searchsorted(cuts, vals, side="right")
             if descending:
                 bucket = (n_out - 1) - bucket
-            outs = []
-            for i in range(n_out):
-                outs.append(t.filter(pa.array(bucket == i)))
-            return outs
+            return _split_by_bucket(t, bucket.astype(np.int64), n_out)
 
         def combine(t: pa.Table) -> pa.Table:
             return t.sort_by(sort_keys)
@@ -699,7 +696,7 @@ class GroupedData:
             if t.num_rows == 0:
                 return [t] * n_out
             bucket = _hash_bucket(t, keys, n_out)
-            return [t.filter(pa.array(bucket == i)) for i in range(n_out)]
+            return _split_by_bucket(t, bucket, n_out)
 
         def combine(t: pa.Table) -> pa.Table:
             if t.num_rows == 0:
@@ -819,11 +816,42 @@ def _common_type(cols) -> pa.DataType:
 
 
 def _hash_bucket(t: pa.Table, keys: List[str], n: int) -> np.ndarray:
+    """Per-row shuffle bucket ids. Numeric null-free keys take the native
+    multithreaded partitioner; anything else (strings, nulls) falls back
+    to the pandas hash. Both are deterministic across processes — every
+    partition buckets independently and equal keys must collide."""
+    from raydp_tpu.native import lib as native
+
+    key_cols = [t.column(k) for k in keys]
+    if all(c.null_count == 0 for c in key_cols):
+        try:
+            arrays = [
+                c.combine_chunks().to_numpy(zero_copy_only=False)
+                for c in key_cols
+            ]
+        except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+            arrays = None
+        if arrays is not None and all(
+            a.dtype.kind in "iuf" for a in arrays
+        ):
+            bucket = native.hash_bucket(arrays, n)
+            if bucket is not None:
+                return bucket
     import pandas as pd
 
     df = t.select(keys).to_pandas()
     codes = pd.util.hash_pandas_object(df, index=False).to_numpy()
     return (codes % n).astype(np.int64)
+
+
+def _split_by_bucket(t: pa.Table, bucket: np.ndarray, n: int) -> List[pa.Table]:
+    """One stable sort + take, then zero-copy slices per bucket — replaces
+    n full filter scans in the exchange splitters."""
+    order = np.argsort(bucket, kind="stable")
+    taken = t.take(pa.array(order))
+    counts = np.bincount(bucket, minlength=n)
+    offsets = np.concatenate([[0], np.cumsum(counts)])
+    return [taken.slice(offsets[i], counts[i]) for i in range(n)]
 
 
 def _partial_name(col_name: str, op: str) -> str:

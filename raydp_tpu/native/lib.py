@@ -57,6 +57,14 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_int64,
             ctypes.c_void_p,
         ]
+    lib.rdp_hash_bucket.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_void_p,
+    ]
     _lib = lib
     return _lib
 
@@ -132,6 +140,41 @@ def _check_indices(indices: np.ndarray, n_src: int) -> None:
             f"gather indices out of range [0, {n_src}) "
             f"(min={indices.min()}, max={indices.max()})"
         )
+
+
+def hash_bucket(
+    columns: Sequence[np.ndarray], n_buckets: int
+) -> Optional[np.ndarray]:
+    """Stable per-row bucket ids from numeric key columns (the shuffle
+    partitioner hot path). Returns None when the native library is absent
+    or a column dtype is unsupported — callers fall back to the pandas
+    hash. Deterministic across processes (splitmix64, no salt)."""
+    lib = _load()
+    if lib is None or not columns:
+        return None
+    cols = []
+    for c in columns:
+        c = np.ascontiguousarray(c)
+        if c.dtype not in _COL_TYPES or c.ndim != 1:
+            return None
+        cols.append(c)
+    n = cols[0].shape[0]
+    if any(c.shape[0] != n for c in cols):
+        return None
+    out = np.empty(n, dtype=np.int64)
+    col_ptrs = (ctypes.c_void_p * len(cols))(
+        *[c.ctypes.data_as(ctypes.c_void_p).value for c in cols]
+    )
+    col_types = np.array([_COL_TYPES[c.dtype] for c in cols], dtype=np.int32)
+    lib.rdp_hash_bucket(
+        col_ptrs,
+        col_types.ctypes.data_as(ctypes.c_void_p),
+        len(cols),
+        n,
+        int(n_buckets),
+        out.ctypes.data_as(ctypes.c_void_p),
+    )
+    return out
 
 
 def gather_rows(src: np.ndarray, indices: np.ndarray) -> np.ndarray:

@@ -125,3 +125,62 @@ void rdp_gather_matrix_i32(const void** cols, const int32_t* col_types,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Hash partitioner: the shuffle hot path. Computes a stable bucket id per
+// row from numeric key columns (splitmix64 mixing, order-sensitive across
+// columns). Must be deterministic across processes — every partition of an
+// exchange computes buckets independently and equal keys must collide.
+
+static inline uint64_t rdp_mix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ULL;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+  return x ^ (x >> 31);
+}
+
+static inline uint64_t load_bits(const void* col, int32_t type, int64_t row) {
+  switch (type) {
+    case COL_F32: {
+      float v = reinterpret_cast<const float*>(col)[row];
+      if (v == 0.0f) v = 0.0f;  // -0.0 → +0.0
+      uint32_t b;
+      std::memcpy(&b, &v, 4);
+      return b;
+    }
+    case COL_F64: {
+      double v = reinterpret_cast<const double*>(col)[row];
+      if (v == 0.0) v = 0.0;
+      uint64_t b;
+      std::memcpy(&b, &v, 8);
+      return b;
+    }
+    case COL_I64:
+      return static_cast<uint64_t>(
+          reinterpret_cast<const int64_t*>(col)[row]);
+    case COL_I32:
+      return static_cast<uint64_t>(static_cast<int64_t>(
+          reinterpret_cast<const int32_t*>(col)[row]));
+    case COL_I16:
+      return static_cast<uint64_t>(static_cast<int64_t>(
+          reinterpret_cast<const int16_t*>(col)[row]));
+    case COL_U8:
+      return reinterpret_cast<const uint8_t*>(col)[row];
+    default:
+      return 0;
+  }
+}
+
+extern "C" void rdp_hash_bucket(const void** cols, const int32_t* col_types,
+                                int64_t ncols, int64_t n, int64_t n_buckets,
+                                int64_t* out) {
+#pragma omp parallel for if (n > 16384)
+  for (int64_t i = 0; i < n; ++i) {
+    uint64_t h = 0x51'7c'c1'b7'27'22'0a'95ULL;
+    for (int64_t c = 0; c < ncols; ++c) {
+      h = rdp_mix64(h ^ rdp_mix64(load_bits(cols[c], col_types[c], i) +
+                                  0x100000001b3ULL * (uint64_t)c));
+    }
+    out[i] = static_cast<int64_t>(h % static_cast<uint64_t>(n_buckets));
+  }
+}

@@ -72,3 +72,57 @@ def test_gather_matrix_rejects_noncontiguous_out():
     bad_out = np.empty((2, 5), dtype=np.float32).T
     with pytest.raises(ValueError):
         native.gather_matrix(cols, idx, out=bad_out)
+
+
+def test_hash_bucket_native_matches_determinism_and_balance():
+    import numpy as np
+
+    from raydp_tpu.native import lib as native
+
+    rng = np.random.default_rng(0)
+    cols = [
+        rng.integers(0, 1000, 100_000),
+        rng.standard_normal(100_000).astype(np.float32),
+    ]
+    b1 = native.hash_bucket(cols, 16)
+    if b1 is None:  # no toolchain: fallback covered elsewhere
+        return
+    b2 = native.hash_bucket(cols, 16)
+    assert (b1 == b2).all()
+    assert b1.min() >= 0 and b1.max() < 16
+    counts = np.bincount(b1, minlength=16)
+    assert counts.std() / counts.mean() < 0.05  # well balanced
+    # equal keys collide regardless of position
+    dup = [np.array([7, 7, 9]), np.array([1.5, 1.5, 2.0], np.float64)]
+    db = native.hash_bucket(dup, 8)
+    assert db[0] == db[1]
+
+
+def test_hash_bucket_unsupported_dtype_falls_back():
+    import numpy as np
+
+    from raydp_tpu.native import lib as native
+
+    assert native.hash_bucket(
+        [np.array(["a", "b"], dtype=object)], 4
+    ) is None
+
+
+def test_split_by_bucket_partitions_everything_once():
+    import numpy as np
+    import pyarrow as pa
+
+    from raydp_tpu.dataframe.dataframe import _hash_bucket, _split_by_bucket
+
+    rng = np.random.default_rng(1)
+    t = pa.table({"k": rng.integers(0, 50, 10_000), "v": rng.random(10_000)})
+    bucket = _hash_bucket(t, ["k"], 8)
+    parts = _split_by_bucket(t, bucket, 8)
+    assert sum(p.num_rows for p in parts) == t.num_rows
+    # a key's rows land in exactly one bucket
+    for k in (0, 17, 49):
+        holders = [
+            i for i, p in enumerate(parts)
+            if (np.asarray(p.column("k")) == k).any()
+        ]
+        assert len(holders) == 1
