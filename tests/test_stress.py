@@ -1,0 +1,125 @@
+"""Concurrency stress tests (SURVEY §5.2 — the reference has no race
+harness at all; locks here get hammered on purpose)."""
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import raydp_tpu
+import raydp_tpu.dataframe as rdf
+from raydp_tpu.store.object_store import OWNER_HOLDER, ObjectStore
+
+
+def test_object_store_concurrent_put_get_delete():
+    store = ObjectStore()
+    errors = []
+
+    def worker(seed):
+        rng = np.random.default_rng(seed)
+        try:
+            for _ in range(40):
+                data = rng.bytes(rng.integers(10, 5000))
+                ref = store.put(data, owner=f"t{seed}")
+                assert store.get_bytes(ref) == data
+                t = pa.table({"x": rng.integers(0, 10, 16)})
+                tref = store.put_arrow_table(t)
+                got = store.get_arrow_table(tref)
+                assert got.num_rows == 16
+                store.transfer_to_holder(ref)
+                store.delete(tref)
+        except Exception as exc:  # pragma: no cover
+            errors.append(exc)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    # every surviving ref is holder-owned or thread-owned and readable
+    for ref in store.refs():
+        if store.contains(ref):
+            store.get_bytes(ref)
+    store.destroy()
+
+
+def test_owner_death_races_with_writes():
+    store = ObjectStore()
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        i = 0
+        while not stop.is_set() and i < 300:
+            store.put(b"x" * 64, owner="doomed")
+            i += 1
+
+    def reaper():
+        while not stop.is_set():
+            store.on_owner_died("doomed")
+
+    threads = [threading.Thread(target=writer) for _ in range(4)] + [
+        threading.Thread(target=reaper) for _ in range(2)
+    ]
+    for t in threads[:4]:
+        t.start()
+    for t in threads[4:]:
+        t.start()
+    for t in threads[:4]:
+        t.join()
+    stop.set()
+    for t in threads[4:]:
+        t.join()
+    store.on_owner_died("doomed")
+    assert all(r.owner != "doomed" for r in store.refs())
+    store.destroy()
+
+
+def test_cluster_concurrent_pipelines_and_tasks():
+    """Several threads drive independent DataFrame pipelines over ONE
+    session while tasks hammer the control plane."""
+    s = raydp_tpu.init(app_name="stress", num_workers=3)
+    errors = []
+    try:
+        def pipeline(seed):
+            try:
+                rng = np.random.default_rng(seed)
+                import pandas as pd
+
+                pdf = pd.DataFrame(
+                    {
+                        "k": rng.integers(0, 10, 3000),
+                        "v": rng.standard_normal(3000),
+                    }
+                )
+                out = (
+                    rdf.from_pandas(pdf, num_partitions=3)
+                    .withColumn("v2", rdf.col("v") * 2)
+                    .groupBy("k")
+                    .agg({"v2": "sum"})
+                    .to_pandas()
+                )
+                exp = pdf.groupby("k")["v"].sum().mul(2)
+                assert np.allclose(
+                    sorted(out["sum(v2)"]), sorted(exp.values)
+                ), seed
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+
+        def pings(n):
+            try:
+                for i in range(n):
+                    s.cluster.submit(lambda ctx, i=i: i * 2, timeout=60.0)
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+
+        with ThreadPoolExecutor(max_workers=8) as pool:
+            futs = [pool.submit(pipeline, i) for i in range(5)]
+            futs += [pool.submit(pings, 25) for _ in range(2)]
+            for f in futs:
+                f.result(timeout=300)
+        assert not errors, errors
+    finally:
+        raydp_tpu.stop()
