@@ -409,15 +409,40 @@ def create_spmd_job(
     timeout: float = 30.0,
     hosts: Optional[List[str]] = None,
     coordinator_port: Optional[int] = None,
+    placement_strategy: Optional[str] = None,
+    placement_group=None,
 ) -> SPMDJob:
     """Create (but do not start) an SPMD job — the reference's
     ``create_mpi_job`` entry point (reference: mpi/__init__.py:36-91).
 
     The MPI-flavor dispatch (OpenMPI/IntelMPI/MPICH) collapses away: there
     is one launcher, and ``script_prepare_fn`` covers launcher
-    customization.
+    customization. ``placement_strategy``/``placement_group`` reserve one
+    bundle per gang host over the cluster's nodes and derive ``hosts``
+    from the assignment (the reference reserves a STRICT_SPREAD group and
+    discovers node IPs with peer actors — mpi/mpi_job.py:193-223).
     """
-    return SPMDJob(
+    pg = placement_group
+    if hosts is None and (placement_strategy is not None or pg is not None):
+        from raydp_tpu.cluster import placement as pl
+        from raydp_tpu.context import current_session
+
+        session = current_session()
+        nodes = (
+            session.cluster.master.nodes
+            if session is not None and hasattr(session.cluster, "master")
+            and hasattr(session.cluster.master, "nodes")
+            else pl.detect_nodes()
+        )
+        n_hosts = -(-world_size // num_procs_per_node)
+        if pg is None:
+            bundles = [{"cpu": float(num_procs_per_node)}] * n_hosts
+            pg = pl.place(bundles, placement_strategy, nodes)
+        addr_of = {n.node_id: n.address for n in nodes}
+        hosts = [
+            addr_of.get(b.node_id, "127.0.0.1") for b in pg.bundles[:n_hosts]
+        ]
+    job = SPMDJob(
         job_name=job_name,
         world_size=world_size,
         num_procs_per_node=num_procs_per_node,
@@ -427,3 +452,5 @@ def create_spmd_job(
         hosts=hosts,
         coordinator_port=coordinator_port,
     )
+    job.placement_group = pg
+    return job

@@ -116,3 +116,33 @@ def test_coordinator_address_shared():
     assert coords[0] == coords[1]
     host, port = coords[0].rsplit(":", 1)
     assert int(port) > 0
+
+
+def test_placement_group_reserves_hosts():
+    """Placement-group form (reference: MPI job over a STRICT_SPREAD
+    group, mpi/mpi_job.py:193-223): bundles land on distinct virtual
+    nodes and the gang still runs."""
+    import os
+
+    os.environ["RAYDP_TPU_VIRTUAL_NODES"] = "2"
+    # Logical CPUs like the reference CI's `ray start --num-cpus 4`.
+    os.environ["RAYDP_TPU_NUM_CPUS"] = "8"
+    try:
+        job = create_spmd_job(
+            job_name="pg-gang",
+            world_size=2,
+            placement_strategy="STRICT_SPREAD",
+            env={"JAX_PLATFORMS": "cpu"},
+        )
+        nodes = {b.node_id for b in job.placement_group.bundles}
+        assert nodes == {"node-0", "node-1"}
+        assert len(job.hosts) == 2
+        job.start()
+        try:
+            out = job.run(lambda ctx: ctx.rank * 10)
+            assert sorted(out) == [0, 10]
+        finally:
+            job.stop()
+    finally:
+        del os.environ["RAYDP_TPU_VIRTUAL_NODES"]
+        del os.environ["RAYDP_TPU_NUM_CPUS"]
