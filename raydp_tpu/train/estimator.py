@@ -80,6 +80,7 @@ class JAXEstimator:
         logical_rules: Optional[Sequence] = None,
         max_failures: int = 3,
         save_every_steps: int = 0,
+        self_supervised: bool = False,
         prefetch: int = 2,
         drop_last: bool = False,
         train_config: Optional[Any] = None,
@@ -136,6 +137,10 @@ class JAXEstimator:
         self.scan_threshold_bytes = scan_threshold_bytes
         self.max_failures = max_failures
         self.save_every_steps = save_every_steps
+        # Self-supervised (language-modeling) mode: no label column; the
+        # loss consumes the inputs as targets (e.g. loss="lm_ce" trains a
+        # CausalLM on next-token prediction).
+        self.self_supervised = self_supervised
         self.prefetch = prefetch
         self.drop_last = drop_last
         # Model-parallel wiring: when the model carries flax logical-axis
@@ -224,6 +229,8 @@ class JAXEstimator:
         takes_deterministic = self._model_takes_deterministic()
 
         def train_step(state: TrainState, x, y, rng):
+            target = y if y is not None else x  # self-supervised: x IS y
+
             def compute(params):
                 if takes_deterministic:
                     preds = state.apply_fn(
@@ -232,7 +239,7 @@ class JAXEstimator:
                     )
                 else:
                     preds = state.apply_fn(params, x)
-                return loss_fn(preds, y)
+                return loss_fn(preds, target)
 
             loss_val, grads = jax.value_and_grad(compute)(state.params)
             return state.apply_gradients(grads=grads), loss_val
@@ -245,8 +252,9 @@ class JAXEstimator:
         train_step = self._make_train_step()
 
         def eval_step(state: TrainState, x, y):
+            target = y if y is not None else x
             preds = state.apply_fn(state.params, x)
-            out = {"loss": loss_fn(preds, y)}
+            out = {"loss": loss_fn(preds, target)}
             for name, fn in metric_fns:
                 out[name] = fn(preds, y)
             return out
@@ -329,9 +337,12 @@ class JAXEstimator:
         exactly that (epoch, batch) — the per-epoch shuffle is
         deterministic and the dropout rng chain is fast-forwarded, so a
         resumed run reproduces the uninterrupted one (SURVEY §5.4)."""
-        if self.feature_columns is None or self.label_column is None:
+        if self.feature_columns is None or (
+            self.label_column is None and not self.self_supervised
+        ):
             raise ValueError(
-                "feature_columns and label_column must be configured"
+                "feature_columns and label_column must be configured "
+                "(label_column may be omitted with self_supervised=True)"
             )
         epochs = num_epochs if num_epochs is not None else self.num_epochs
         if self._use_scan(train_ds) and resume_from is None:
@@ -482,7 +493,9 @@ class JAXEstimator:
 
     def _materialize_all(self, ds: MLDataset):
         """All shards → one (x, y) pair of host arrays."""
-        wanted = list(self.feature_columns) + [self.label_column]
+        wanted = list(self.feature_columns) + (
+            [self.label_column] if self.label_column else []
+        )
         xs, ys = [], []
         for rank in range(ds.num_shards):
             cols = ds.shard_columns(rank, wanted)
@@ -495,11 +508,14 @@ class JAXEstimator:
                     axis=1,
                 )
             )
-            ys.append(
-                cols[self.label_column].astype(self.label_dtype, copy=False)
-            )
+            if self.label_column:
+                ys.append(
+                    cols[self.label_column].astype(
+                        self.label_dtype, copy=False
+                    )
+                )
         x = np.concatenate(xs) if len(xs) > 1 else xs[0]
-        y = np.concatenate(ys) if len(ys) > 1 else ys[0]
+        y = (np.concatenate(ys) if len(ys) > 1 else ys[0]) if ys else None
         return x, y
 
     def _build_epoch_fn(self, n_steps: int, batch: int):
@@ -511,19 +527,30 @@ class JAXEstimator:
             if shuffle:
                 perm = jax.random.permutation(key, n)
                 x = x[perm]
-                y = y[perm]
+                if y is not None:
+                    y = y[perm]
             xb = x.reshape((n_steps, batch) + x.shape[1:])
-            yb = y.reshape((n_steps, batch) + y.shape[1:])
+            yb = (
+                y.reshape((n_steps, batch) + y.shape[1:])
+                if y is not None else None
+            )
 
             def body(state, inp):
-                xs, ys, step = inp
+                if yb is not None:
+                    xs, ys, step = inp
+                else:
+                    xs, step = inp
+                    ys = None
                 step_key = jax.random.fold_in(key, step)
                 state, loss_val = train_step(state, xs, ys, step_key)
                 return state, loss_val
 
-            state, losses = jax.lax.scan(
-                body, state, (xb, yb, jnp.arange(n_steps))
+            xs_in = (
+                (xb, yb, jnp.arange(n_steps))
+                if yb is not None
+                else (xb, jnp.arange(n_steps))
             )
+            state, losses = jax.lax.scan(body, state, xs_in)
             return state, losses.mean()
 
         return jax.jit(epoch_fn, donate_argnums=0)
@@ -561,7 +588,7 @@ class JAXEstimator:
             x, y = _pad_cycle(x, y, pad)
         sharding = self.data_sharding
         xd = jax.device_put(x, sharding)
-        yd = jax.device_put(y, sharding)
+        yd = jax.device_put(y, sharding) if y is not None else None
         epoch_fn = self._build_epoch_fn(n_steps, batch)
         rng = jax.random.PRNGKey(self.seed + 1)
         for epoch in range(epochs):

@@ -46,6 +46,17 @@ def softmax_crossentropy(logits, targets):
     )
 
 
+def lm_crossentropy(logits, tokens):
+    """Next-token language-modeling loss: ``logits`` are the model's
+    outputs on the full sequence ``tokens`` — position t predicts token
+    t+1 (the self-supervised objective; targets are the inputs shifted)."""
+    return jnp.mean(
+        optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1, :], tokens[:, 1:].astype(jnp.int32)
+        )
+    )
+
+
 LOSSES: Dict[str, Callable] = {
     "mse": mse,
     "mae": mae,
@@ -55,6 +66,7 @@ LOSSES: Dict[str, Callable] = {
     "binary_crossentropy": binary_crossentropy,
     "softmax_ce": softmax_crossentropy,
     "sparse_categorical_crossentropy": softmax_crossentropy,
+    "lm_ce": lm_crossentropy,
 }
 
 
