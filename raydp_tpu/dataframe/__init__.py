@@ -49,6 +49,10 @@ from raydp_tpu.dataframe.window import (
     lead,
     rank,
     row_number,
+    window_count,
+    window_max,
+    window_mean,
+    window_min,
     window_sum,
 )
 from raydp_tpu.dataframe.io import (
@@ -70,6 +74,7 @@ __all__ = [
     "monotonically_increasing_id",
     "Window", "WindowSpec", "asc", "desc",
     "row_number", "rank", "dense_rank", "lag", "lead", "window_sum",
+    "window_min", "window_max", "window_mean", "window_count",
     "from_arrow", "from_items", "from_pandas", "from_refs", "range",
     "read_csv", "read_parquet",
 ]

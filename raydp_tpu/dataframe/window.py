@@ -32,6 +32,10 @@ __all__ = [
     "lead",
     "cume_count",
     "window_sum",
+    "window_min",
+    "window_max",
+    "window_mean",
+    "window_count",
     "find_window_exprs",
 ]
 
@@ -121,8 +125,25 @@ def cume_count() -> WindowFunction:
 
 
 def window_sum(column: str) -> WindowFunction:
-    """Sum of ``column`` over the whole window partition."""
+    """Sum of ``column`` over the window frame."""
     return WindowFunction("sum", column)
+
+
+def window_min(column: str) -> WindowFunction:
+    return WindowFunction("min", column)
+
+
+def window_max(column: str) -> WindowFunction:
+    return WindowFunction("max", column)
+
+
+def window_mean(column: str) -> WindowFunction:
+    return WindowFunction("mean", column)
+
+
+def window_count(column: str) -> WindowFunction:
+    """Non-null count of ``column`` over the window frame."""
+    return WindowFunction("count", column)
 
 
 class WindowExpr(Expr):
@@ -198,28 +219,42 @@ class WindowExpr(Expr):
                     size = grouped[self.fn.column].transform("size")
                     hole = pos >= size + n
                 out = out.mask(hole, self.fn.default)
-        elif kind == "sum":
+        elif kind in ("sum", "min", "max", "mean", "count"):
             # Spark frame semantics: with orderBy the default frame is
-            # RANGE unboundedPreceding..currentRow — a running sum where
-            # order-key ties (peer rows) all get the full peer total;
-            # without orderBy, the whole partition.
+            # RANGE unboundedPreceding..currentRow — a running aggregate
+            # where order-key ties (peer rows) all get the full peer
+            # frame total; without orderBy, the whole partition.
             if order:
-                csum = grouped[self.fn.column].cumsum()
+                col_s = grouped[self.fn.column]
+                if kind == "sum":
+                    run = col_s.cumsum()
+                elif kind == "min":
+                    run = col_s.cummin()
+                elif kind == "max":
+                    run = col_s.cummax()
+                elif kind == "count":
+                    run = col_s.transform(
+                        lambda s: s.notna().cumsum()
+                    )
+                else:  # mean = running sum / running non-null count
+                    run = col_s.cumsum() / col_s.transform(
+                        lambda s: s.notna().cumsum()
+                    )
                 peer_cols = [ordered[c] for c in keys] + [
                     ordered[k.column] for k in order
                 ]
-                # Peer total = cumsum at the group's LAST row ("max" would
-                # be wrong for negative values: cumsum isn't monotone).
-                out = csum.groupby(peer_cols, dropna=False).transform("last")
-                # A peer group whose values are all null has no cumsum of
-                # its own; Spark carries the prior frame total forward
-                # (leading nulls stay null: empty frame sums to null).
-                if out.isna().any():
+                # Peer value = running aggregate at the peer group's LAST
+                # row ("max" would be wrong for non-monotone runs).
+                out = run.groupby(peer_cols, dropna=False).transform("last")
+                # A peer group whose values are all null has no running
+                # value of its own; Spark carries the prior frame value
+                # forward (leading nulls stay null: empty frame).
+                if kind != "count" and out.isna().any():
                     out = out.groupby(
                         [ordered[c] for c in keys], dropna=False
                     ).ffill()
             else:
-                out = grouped[self.fn.column].transform("sum")
+                out = grouped[self.fn.column].transform(kind)
         else:
             raise ValueError(f"unknown window function {kind!r}")
 

@@ -343,3 +343,65 @@ def test_window_on_cluster_executor(session):
     got = {(r.column_id, r.data): r.id for r in out.itertuples()}
     assert got[(0, "u")] == 0 and got[(0, "v")] == 1
     assert got[(1, "w")] == 0 and got[(1, "u")] == 1
+
+
+def test_window_min_max_mean_count_whole_partition():
+    import numpy as np
+    import pandas as pd
+
+    from raydp_tpu.dataframe import (
+        Window,
+        window_count,
+        window_max,
+        window_mean,
+        window_min,
+    )
+
+    rng = np.random.default_rng(2)
+    pdf = pd.DataFrame(
+        {"k": rng.integers(0, 5, 300), "v": rng.standard_normal(300)}
+    )
+    pdf.loc[::17, "v"] = np.nan
+    w = Window.partitionBy("k")
+    out = (
+        rdf.from_pandas(pdf, num_partitions=3)
+        .withColumn("mn", window_min("v").over(w))
+        .withColumn("mx", window_max("v").over(w))
+        .withColumn("avg", window_mean("v").over(w))
+        .withColumn("cnt", window_count("v").over(w))
+        .to_pandas()
+    )
+    g = pdf.groupby("k")["v"]
+    for k, sub in out.groupby("k"):
+        assert np.allclose(sub["mn"], g.min()[k])
+        assert np.allclose(sub["mx"], g.max()[k])
+        assert np.allclose(sub["avg"], g.mean()[k])
+        assert (sub["cnt"] == g.count()[k]).all()
+
+
+def test_window_running_aggregates_with_order():
+    import numpy as np
+    import pandas as pd
+
+    from raydp_tpu.dataframe import Window, window_max, window_mean
+
+    pdf = pd.DataFrame(
+        {
+            "k": [0, 0, 0, 0, 1, 1],
+            "t": [1, 2, 3, 4, 1, 2],
+            "v": [5.0, 1.0, 7.0, 3.0, 2.0, 8.0],
+        }
+    )
+    w = Window.partitionBy("k").orderBy("t")
+    out = (
+        rdf.from_pandas(pdf, num_partitions=2)
+        .withColumn("runmax", window_max("v").over(w))
+        .withColumn("runavg", window_mean("v").over(w))
+        .to_pandas()
+        .sort_values(["k", "t"])
+        .reset_index(drop=True)
+    )
+    assert out["runmax"].tolist() == [5.0, 5.0, 7.0, 7.0, 2.0, 8.0]
+    assert np.allclose(
+        out["runavg"], [5.0, 3.0, 13 / 3, 4.0, 2.0, 5.0]
+    )
