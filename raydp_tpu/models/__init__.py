@@ -1,4 +1,5 @@
 from raydp_tpu.models.mlp import MLP, binary_classifier, taxi_fare_regressor
+from raydp_tpu.models.pipelined import PipelinedClassifier
 from raydp_tpu.models.transformer import (
     CausalLM,
     SequenceClassifier,
@@ -28,6 +29,7 @@ from raydp_tpu.models.moe import (
 )
 
 __all__ = [
+    "PipelinedClassifier",
     "MoEBlock",
     "MoEConfig",
     "MoELayer",

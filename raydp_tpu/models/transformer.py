@@ -44,6 +44,7 @@ LOGICAL_RULES: Tuple[Tuple[str, Optional[str]], ...] = (
     ("kv", None),
     ("mlp", "tp"),
     ("pooled", None),
+    ("stage", "pp"),  # stacked pipeline-stage axis (models/pipelined.py)
 )
 
 
