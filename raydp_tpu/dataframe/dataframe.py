@@ -816,27 +816,37 @@ def _common_type(cols) -> pa.DataType:
 
 
 def _hash_bucket(t: pa.Table, keys: List[str], n: int) -> np.ndarray:
-    """Per-row shuffle bucket ids. Numeric null-free keys take the native
-    multithreaded partitioner; anything else (strings, nulls) falls back
-    to the pandas hash. Both are deterministic across processes — every
-    partition buckets independently and equal keys must collide."""
+    """Per-row shuffle bucket ids.
+
+    CONSISTENCY: partitions of one exchange hash independently in
+    different processes, so the algorithm choice must depend only on the
+    SCHEMA (identical across partitions), never on per-partition
+    properties. Numeric key schemas take the splitmix64 partitioner
+    (native kernel, or its bit-exact numpy twin when the .so is absent)
+    with nulls carried as explicit validity columns; anything else uses
+    the pandas hash.
+    """
     from raydp_tpu.native import lib as native
 
-    key_cols = [t.column(k) for k in keys]
-    if all(c.null_count == 0 for c in key_cols):
-        try:
-            arrays = [
-                c.combine_chunks().to_numpy(zero_copy_only=False)
-                for c in key_cols
-            ]
-        except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
-            arrays = None
-        if arrays is not None and all(
-            a.dtype.kind in "iuf" for a in arrays
-        ):
-            bucket = native.hash_bucket(arrays, n)
-            if bucket is not None:
-                return bucket
+    fields = [t.schema.field(k).type for k in keys]
+    if all(
+        pa.types.is_integer(ft) or pa.types.is_floating(ft) for ft in fields
+    ):
+        arrays, masks = [], []
+        for k in keys:
+            c = t.column(k).combine_chunks()
+            # Nulls: hash a typed zero plus the validity bit as an extra
+            # u8 column — null-free partitions produce all-ones masks, so
+            # results stay consistent whether or not nulls are present.
+            masks.append(
+                pc.is_valid(c).to_numpy(zero_copy_only=False).astype(np.uint8)
+            )
+            arrays.append(
+                pc.fill_null(c, 0).to_numpy(zero_copy_only=False)
+            )
+        bucket = native.hash_bucket(arrays + masks, n)
+        if bucket is not None:
+            return bucket
     import pandas as pd
 
     df = t.select(keys).to_pandas()

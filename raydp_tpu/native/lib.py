@@ -146,11 +146,16 @@ def hash_bucket(
     columns: Sequence[np.ndarray], n_buckets: int
 ) -> Optional[np.ndarray]:
     """Stable per-row bucket ids from numeric key columns (the shuffle
-    partitioner hot path). Returns None when the native library is absent
-    or a column dtype is unsupported — callers fall back to the pandas
-    hash. Deterministic across processes (splitmix64, no salt)."""
-    lib = _load()
-    if lib is None or not columns:
+    partitioner hot path). Returns None when a column dtype is
+    unsupported — callers fall back to the pandas hash.
+
+    CONSISTENCY CONTRACT: every partition of one exchange must assign
+    equal keys to equal buckets, and partitions are hashed in different
+    processes. Therefore the RESULT depends only on the values: when the
+    native library is unavailable, an exact numpy twin of the splitmix64
+    kernel computes the identical buckets (never a different algorithm).
+    """
+    if not columns:
         return None
     cols = []
     for c in columns:
@@ -161,6 +166,9 @@ def hash_bucket(
     n = cols[0].shape[0]
     if any(c.shape[0] != n for c in cols):
         return None
+    lib = _load()
+    if lib is None:
+        return _hash_bucket_numpy(cols, n_buckets)
     out = np.empty(n, dtype=np.int64)
     col_ptrs = (ctypes.c_void_p * len(cols))(
         *[c.ctypes.data_as(ctypes.c_void_p).value for c in cols]
@@ -175,6 +183,39 @@ def hash_bucket(
         out.ctypes.data_as(ctypes.c_void_p),
     )
     return out
+
+
+def _splitmix64_np(x: np.ndarray) -> np.ndarray:
+    """Vectorized twin of the C++ rdp_mix64 (bit-exact)."""
+    x = x + np.uint64(0x9E3779B97F4A7C15)
+    x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return x ^ (x >> np.uint64(31))
+
+
+def _load_bits_np(c: np.ndarray) -> np.ndarray:
+    """Twin of the C++ load_bits: the uint64 the kernel hashes."""
+    if c.dtype == np.float32:
+        c = np.where(c == 0.0, np.float32(0.0), c)  # -0.0 → +0.0
+        return c.view(np.uint32).astype(np.uint64)
+    if c.dtype == np.float64:
+        c = np.where(c == 0.0, 0.0, c)
+        return c.view(np.uint64)
+    if c.dtype == np.uint8:
+        return c.astype(np.uint64)
+    # signed ints: sign-extend exactly like the C++ int64_t cast
+    return c.astype(np.int64).view(np.uint64)
+
+
+def _hash_bucket_numpy(cols, n_buckets: int) -> np.ndarray:
+    with np.errstate(over="ignore"):
+        h = np.full(cols[0].shape[0], 0x517CC1B727220A95, dtype=np.uint64)
+        for i, c in enumerate(cols):
+            v = _load_bits_np(c) + np.uint64(
+                (0x100000001B3 * i) & 0xFFFFFFFFFFFFFFFF
+            )
+            h = _splitmix64_np(h ^ _splitmix64_np(v))
+        return (h % np.uint64(n_buckets)).astype(np.int64)
 
 
 def gather_rows(src: np.ndarray, indices: np.ndarray) -> np.ndarray:

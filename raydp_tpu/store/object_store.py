@@ -61,14 +61,32 @@ def get_current_resolver():
     return _current_resolver
 
 
-def resolve_ambient_table(ref) -> pa.Table:
+# Small per-process cache for repeatedly-resolved shared tables (e.g. the
+# broadcast side of a join is read by EVERY partition task on this
+# worker; without the cache a remote worker re-fetches it over gRPC once
+# per partition). Tables are immutable; bounded FIFO eviction.
+_AMBIENT_CACHE_MAX = 8
+_ambient_cache: "dict[str, pa.Table]" = {}
+
+
+def resolve_ambient_table(ref, cache: bool = True) -> pa.Table:
     """Read an Arrow table by ref using whatever this process has: the
-    node-aware resolver if one is installed, else the plain local store."""
+    node-aware resolver if one is installed, else the plain local store.
+    ``cache=True`` memoizes per object id (for broadcast-style reads)."""
+    object_id = ref.object_id if isinstance(ref, ObjectRef) else ref
+    if cache and object_id in _ambient_cache:
+        return _ambient_cache[object_id]
     if _current_resolver is not None:
-        return _current_resolver.get_arrow_table(ref)
-    if _current_store is not None:
-        return _current_store.get_arrow_table(ref)
-    raise RuntimeError("no ambient object store/resolver in this process")
+        table = _current_resolver.get_arrow_table(ref)
+    elif _current_store is not None:
+        table = _current_store.get_arrow_table(ref)
+    else:
+        raise RuntimeError("no ambient object store/resolver in this process")
+    if cache:
+        while len(_ambient_cache) >= _AMBIENT_CACHE_MAX:
+            _ambient_cache.pop(next(iter(_ambient_cache)))
+        _ambient_cache[object_id] = table
+    return table
 
 
 @dataclass(frozen=True)

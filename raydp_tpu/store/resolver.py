@@ -44,12 +44,21 @@ class ObjectResolver:
     # -- reads ----------------------------------------------------------
     def get_bytes(self, ref_or_id) -> bytes:
         if self._is_local(ref_or_id):
-            return self._store.get_bytes(ref_or_id)
+            try:
+                return self._store.get_bytes(ref_or_id)
+            except (FileNotFoundError, KeyError):
+                # A ref stamped with this node id whose segment is absent
+                # here (e.g. a process configured with the wrong node
+                # identity): fall through to the directory + agents.
+                pass
         return self._fetch_remote(_object_id(ref_or_id))
 
     def get_buffer(self, ref_or_id) -> pa.Buffer:
         if self._is_local(ref_or_id):
-            return self._store.get_buffer(ref_or_id)
+            try:
+                return self._store.get_buffer(ref_or_id)
+            except (FileNotFoundError, KeyError):
+                pass
         return pa.py_buffer(self._fetch_remote(_object_id(ref_or_id)))
 
     def get_arrow_table(self, ref_or_id) -> pa.Table:

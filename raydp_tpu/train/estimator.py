@@ -252,11 +252,11 @@ class JAXEstimator:
         train_step = self._make_train_step()
 
         def eval_step(state: TrainState, x, y):
-            target = y if y is not None else x
+            target = y if y is not None else x  # self-supervised: x IS y
             preds = state.apply_fn(state.params, x)
             out = {"loss": loss_fn(preds, target)}
             for name, fn in metric_fns:
-                out[name] = fn(preds, y)
+                out[name] = fn(preds, target)
             return out
 
         self._train_step = jax.jit(train_step, donate_argnums=0)

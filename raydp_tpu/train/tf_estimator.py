@@ -215,11 +215,17 @@ class TFEstimator:
         label_dtype = (
             np.int32 if loss_name == "softmax_ce" else np.float32
         )
+        unknown = [m for m in metrics if m not in _METRICS]
+        if unknown:
+            raise ValueError(
+                f"unsupported keras metrics {unknown}; known: "
+                f"{sorted(_METRICS)}"
+            )
         self._impl = JAXEstimator(
             model=KerasSequential(layer_configs=self.layer_configs),
             optimizer=parse_keras_optimizer(optimizer),
             loss=loss_name,
-            metrics=[_METRICS[m] for m in metrics if m in _METRICS],
+            metrics=[_METRICS[m] for m in metrics],
             num_epochs=num_epochs,
             batch_size=batch_size,
             feature_columns=feature_columns,

@@ -136,29 +136,33 @@ def _all_rows(ds: MLDataset, columns: Sequence[str]) -> Dict[str, np.ndarray]:
     return {k: v[: ds.total_rows] for k, v in full.items()}
 
 
-def _true_shard_sizes(ds: MLDataset) -> List[int]:
-    """Rows each shard contributes to the original sequence (the last
-    shard's wrap-around padding excluded)."""
-    padded = [
-        sum(s.num_samples for s in ds.shard_plan[r])
-        for r in range(ds.num_shards)
-    ]
-    total, out, seen = ds.total_rows, [], 0
+def _clamp_to_true(padded: List[int], total: int) -> List[int]:
+    """Rows each padded shard contributes to the original sequence (the
+    wrap-around padding excluded). Only correct while divide_blocks
+    places its padding exclusively on TRAILING ranks: once a rank is
+    clamped short, every later rank must be pure padding (true size 0) —
+    asserted."""
+    out, seen = [], 0
     for n in padded:
         out.append(min(n, max(0, total - seen)))
         seen += n
-    # The clamp above is only correct while divide_blocks places its
-    # wrap-around padding exclusively on TRAILING ranks: once a rank is
-    # clamped short, every later rank must be pure padding (true size 0).
     first_short = next(
         (i for i, (n, t) in enumerate(zip(padded, out)) if t < n), None
     )
     if first_short is not None:
         assert all(t == 0 for t in out[first_short + 1:]), (
-            "divide_blocks padding layout changed; _true_shard_sizes "
+            "divide_blocks padding layout changed; true-size clamp "
             f"misattributes rows: padded={padded} true={out}"
         )
     return out
+
+
+def _true_shard_sizes(ds: MLDataset) -> List[int]:
+    padded = [
+        sum(s.num_samples for s in ds.shard_plan[r])
+        for r in range(ds.num_shards)
+    ]
+    return _clamp_to_true(padded, ds.total_rows)
 
 
 def _materialize_plan(
@@ -168,6 +172,7 @@ def _materialize_plan(
     plan: List[Any],
     columns: Sequence[str],
     true_rows: Optional[int] = None,
+    node_id: Optional[str] = None,
 ) -> Dict[str, np.ndarray]:
     """Rank-side shard materialization straight from the object store.
 
@@ -181,8 +186,12 @@ def _materialize_plan(
     from raydp_tpu.store.object_store import DEFAULT_NODE, ObjectStore
     from raydp_tpu.store.resolver import ObjectResolver
 
+    # The gang currently launches on the driver host (node-0); ranks on
+    # other hosts should pass their own node_id. Either way the resolver
+    # falls back to an agent fetch when a "local" segment is absent, so a
+    # wrong node identity degrades to remote reads rather than failing.
     client = RpcClient(master_address, "raydp.AppMaster")
-    store = ObjectStore(namespace=namespace, node_id=DEFAULT_NODE)
+    store = ObjectStore(namespace=namespace, node_id=node_id or DEFAULT_NODE)
 
     def meta(object_id):
         reply = client.call("GetObjectMeta", {"object_id": object_id})
@@ -625,10 +634,7 @@ class TorchEstimator:
                 padded = [
                     sum(s.num_samples for s in ep[r]) for r in range(world)
                 ]
-                total, eval_true, seen = evaluate_ds.total_rows, [], 0
-                for n in padded:
-                    eval_true.append(min(n, max(0, total - seen)))
-                    seen += n
+                eval_true = _clamp_to_true(padded, evaluate_ds.total_rows)
             else:
                 # Too few eval blocks to split: rank 0 evaluates the whole
                 # set (the reference's behavior), no gang reduce.
