@@ -22,6 +22,7 @@ from raydp_tpu.models.dlrm import (
 
 from raydp_tpu.models.moe import (
     MoEBlock,
+    MoEClassifier,
     MoEConfig,
     MoELayer,
     moe_aux_loss,
@@ -31,6 +32,7 @@ from raydp_tpu.models.moe import (
 __all__ = [
     "PipelinedClassifier",
     "MoEBlock",
+    "MoEClassifier",
     "MoEConfig",
     "MoELayer",
     "moe_aux_loss",

@@ -201,6 +201,44 @@ class MoEBlock(nn.Module):
         return x + MoELayer(self.moe, name="moe")(y)
 
 
+class MoEClassifier(nn.Module):
+    """Sequence classifier whose FFNs are routed MoE layers — the
+    expert-parallel model family reachable straight through
+    ``JAXEstimator.fit`` (pass ``aux_losses=True`` so the Switch
+    load-balancing regularizer joins the objective)."""
+
+    cfg: Any          # TransformerConfig (attention/embedding side)
+    moe: MoEConfig
+    num_classes: int = 2
+
+    @nn.compact
+    def __call__(self, ids, deterministic: bool = True):
+        from raydp_tpu.models.transformer import _embed_init
+
+        cfg = self.cfg
+        e = nn.Embed(
+            cfg.vocab_size, cfg.d_model,
+            embedding_init=_embed_init("vocab", "embed"),
+            param_dtype=cfg.param_dtype, name="tok",
+        )(ids)
+        pos = self.param(
+            "pos", _embed_init("kv", "embed"),
+            (cfg.max_len, cfg.d_model), cfg.param_dtype,
+        )
+        x = (e + pos[None, : ids.shape[1], :]).astype(cfg.dtype)
+        for i in range(cfg.n_layers):
+            x = MoEBlock(cfg, self.moe, name=f"block_{i}")(
+                x, deterministic
+            )
+        pooled = nn.LayerNorm(
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="ln_f",
+        )(x)[:, 0]
+        return nn.Dense(
+            self.num_classes, dtype=jnp.float32,
+            param_dtype=cfg.param_dtype, name="head",
+        )(pooled.astype(jnp.float32))
+
+
 def moe_aux_loss(variables) -> jnp.ndarray:
     """Sum every sown MoE aux loss out of ``mutable=['losses']`` state."""
     losses = variables.get("losses", {}) if isinstance(variables, dict) else {}

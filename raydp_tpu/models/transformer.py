@@ -45,6 +45,7 @@ LOGICAL_RULES: Tuple[Tuple[str, Optional[str]], ...] = (
     ("mlp", "tp"),
     ("pooled", None),
     ("stage", "pp"),  # stacked pipeline-stage axis (models/pipelined.py)
+    ("expert", "dp"),  # MoE expert axis shards over dp (models/moe.py)
 )
 
 

@@ -78,6 +78,7 @@ class JAXEstimator:
         scan_threshold_bytes: int = 2 << 30,
         shard_params: bool = True,
         logical_rules: Optional[Sequence] = None,
+        aux_losses: bool = False,
         max_failures: int = 3,
         save_every_steps: int = 0,
         self_supervised: bool = False,
@@ -141,6 +142,10 @@ class JAXEstimator:
         # loss consumes the inputs as targets (e.g. loss="lm_ce" trains a
         # CausalLM on next-token prediction).
         self.self_supervised = self_supervised
+        # aux_losses=True: the model sows regularizers into the "losses"
+        # collection (MoE load-balancing); the train step collects them
+        # via mutable apply and adds the sum to the objective.
+        self.aux_losses = aux_losses
         self.prefetch = prefetch
         self.drop_last = drop_last
         # Model-parallel wiring: when the model carries flax logical-axis
@@ -198,9 +203,18 @@ class JAXEstimator:
         model, tx = self._model, self._tx
 
         def create():
-            params = model.init(rng, sample)
+            variables = model.init(rng, sample)
+            # Output collections sown during init (MoE aux losses,
+            # intermediates) are NOT parameters — keeping them would feed
+            # them to the optimizer as trainables.
+            if isinstance(variables, dict):
+                variables = {
+                    k: v
+                    for k, v in variables.items()
+                    if k not in ("losses", "intermediates")
+                }
             return TrainState.create(
-                apply_fn=model.apply, params=params, tx=tx
+                apply_fn=model.apply, params=variables, tx=tx
             )
 
         if self.shard_params:
@@ -227,18 +241,25 @@ class JAXEstimator:
         stream and scan paths."""
         loss_fn = self._loss_fn
         takes_deterministic = self._model_takes_deterministic()
+        use_aux = self.aux_losses
 
         def train_step(state: TrainState, x, y, rng):
             target = y if y is not None else x  # self-supervised: x IS y
 
             def compute(params):
-                if takes_deterministic:
-                    preds = state.apply_fn(
-                        params, x, deterministic=False,
-                        rngs={"dropout": rng},
+                kwargs = (
+                    dict(deterministic=False, rngs={"dropout": rng})
+                    if takes_deterministic
+                    else {}
+                )
+                if use_aux:
+                    preds, mut = state.apply_fn(
+                        params, x, mutable=["losses"], **kwargs
                     )
-                else:
-                    preds = state.apply_fn(params, x)
+                    from raydp_tpu.models.moe import moe_aux_loss
+
+                    return loss_fn(preds, target) + moe_aux_loss(mut)
+                preds = state.apply_fn(params, x, **kwargs)
                 return loss_fn(preds, target)
 
             loss_val, grads = jax.value_and_grad(compute)(state.params)
@@ -251,9 +272,17 @@ class JAXEstimator:
         metric_fns = list(self._metrics)
         train_step = self._make_train_step()
 
+        use_aux = self.aux_losses
+
         def eval_step(state: TrainState, x, y):
             target = y if y is not None else x  # self-supervised: x IS y
-            preds = state.apply_fn(state.params, x)
+            if use_aux:
+                # Eval loss excludes regularizers (drop the sown values).
+                preds, _ = state.apply_fn(
+                    state.params, x, mutable=["losses"]
+                )
+            else:
+                preds = state.apply_fn(state.params, x)
             out = {"loss": loss_fn(preds, target)}
             for name, fn in metric_fns:
                 out[name] = fn(preds, target)

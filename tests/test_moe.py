@@ -159,3 +159,63 @@ def test_moe_block_trains():
         params, opt, l = step(params, opt)
         losses.append(float(l))
     assert losses[-1] < losses[0], losses
+
+
+def test_moe_classifier_through_estimator(eight_cpu_devices):
+    """Expert parallelism at the product level: MoEClassifier trains via
+    JAXEstimator.fit with expert weights sharded over dp (the ep axis)
+    and the Switch aux loss in the objective."""
+    import jax.tree_util as jtu
+    import optax
+    import pandas as pd
+
+    from raydp_tpu.models import MoEClassifier
+    from raydp_tpu.models.moe import MoEConfig
+    from raydp_tpu.models.transformer import tiny_transformer
+    from raydp_tpu.parallel import MeshSpec
+    from raydp_tpu.train import JAXEstimator
+
+    SEQ, VOCAB = 16, 64
+    rng = np.random.default_rng(0)
+    ids = rng.integers(10, VOCAB, size=(512, SEQ))
+    pos = rng.random(512) < 0.5
+    ids[pos, rng.integers(0, SEQ, pos.sum())] = 7
+    pdf = pd.DataFrame({f"t{i}": ids[:, i] for i in range(SEQ)})
+    pdf["label"] = pos.astype(np.int64)
+
+    cfg = tiny_transformer(
+        max_len=SEQ, vocab_size=VOCAB, dropout_rate=0.0, n_layers=2
+    )
+    moe = MoEConfig(
+        d_model=cfg.d_model, d_ff=cfg.d_ff, n_experts=4, top_k=1,
+        capacity_factor=2.0,
+    )
+    est = JAXEstimator(
+        model=MoEClassifier(cfg=cfg, moe=moe, num_classes=2),
+        optimizer=optax.adam(3e-4),
+        loss="softmax_ce",
+        num_epochs=3,
+        batch_size=64,
+        feature_columns=[f"t{i}" for i in range(SEQ)],
+        label_column="label",
+        feature_dtype=np.int32,
+        label_dtype=np.int32,
+        mesh=MeshSpec(dp=2, tp=2),
+        aux_losses=True,
+        seed=0,
+        shuffle=False,
+    )
+    history = est.fit_on_df(pdf)
+    assert history[-1]["train_loss"] < history[0]["train_loss"]
+    # expert tensors sharded over the ep(dp) axis
+    expert_leaves = [
+        (jtu.keystr(path), x)
+        for path, x in jtu.tree_leaves_with_path(est._state.params)
+        if "w_up" in jtu.keystr(path) or "w_down" in jtu.keystr(path)
+    ]
+    assert expert_leaves
+    assert all(
+        "dp" in str(x.sharding.spec) for _, x in expert_leaves
+    ), [str(x.sharding.spec) for _, x in expert_leaves]
+    # the losses collection was stripped from trainable state
+    assert "losses" not in est._state.params
