@@ -31,7 +31,7 @@ import jax  # noqa: E402
 if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
     jax.config.update("jax_platforms", "cpu")
 
-MASTER_PORT = 43117
+MASTER_PORT = int(os.environ.get("RAYDP_TPU_POD_MASTER_PORT", "43117"))
 
 
 def run_driver(args):

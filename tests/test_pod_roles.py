@@ -1,0 +1,50 @@
+"""The real multi-process pod bring-up: a driver process with
+num_workers=0 plus a host process contributing a store agent + workers
+over the network (what deploy/k8s/raydp-tpu-pod.yaml runs)."""
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from raydp_tpu.utils.net import find_free_port
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_driver_and_host_roles_cross_process():
+    port = find_free_port()
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "RAYDP_TPU_POD_MASTER_PORT": str(port),
+    }
+    script = os.path.join(REPO, "examples", "pod_driver.py")
+    host = subprocess.Popen(
+        [
+            sys.executable, script, "--role", "host",
+            "--driver-host", "127.0.0.1", "--bind-host", "127.0.0.1",
+            "--node-id", "pod-1", "--workers-per-host", "2",
+        ],
+        env=env, cwd=REPO,
+        stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+    )
+    try:
+        driver = subprocess.run(
+            [
+                sys.executable, script, "--role", "driver",
+                "--bind-host", "127.0.0.1", "--expect-workers", "2",
+                "--join-timeout", "90",
+            ],
+            env=env, cwd=REPO, capture_output=True, text=True, timeout=240,
+        )
+        assert driver.returncode == 0, driver.stdout[-2000:] + driver.stderr[-2000:]
+        assert "pod_driver driver OK" in driver.stdout
+        assert "pod-1" in driver.stdout  # workers joined from the host pod
+    finally:
+        host.terminate()
+        try:
+            host.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            host.kill()
