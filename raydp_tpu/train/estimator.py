@@ -301,14 +301,22 @@ class JAXEstimator:
             return False
 
     def _shard_batch(self, x, y):
-        """Global batch → mesh-sharded device arrays. The batch dim splits
-        over dp; a second (sequence) dim additionally splits over sp when
-        the mesh has one — tokens land pre-sharded for sequence-parallel
-        attention. XLA derives the gradient psum from these shardings."""
+        """Batch → mesh-sharded device arrays. The batch dim splits over
+        dp; a second (sequence) dim additionally splits over sp when the
+        mesh has one — tokens land pre-sharded for sequence-parallel
+        attention. XLA derives the gradient psum from these shardings.
+
+        Multi-process (jax.distributed) mode: ``x`` is THIS process's
+        slice of the global batch; slices assemble into one global array
+        via make_array_from_process_local_data (the multi-host data-
+        parallel story — each host feeds its own shard, gradients psum
+        over the global dp axis)."""
         mesh = self._ensure_mesh()
+        n_proc = jax.process_count()
         # Only the dp axis shards the batch; padding to the full mesh size
-        # would duplicate rows needlessly on dp+tp/sp meshes.
-        pad = (-len(x)) % self.mesh_spec.dp
+        # would duplicate rows needlessly on dp+tp/sp meshes. Per process,
+        # rows must split over the LOCAL share of the dp axis.
+        pad = (-len(x)) % max(1, self.mesh_spec.dp // n_proc)
         if pad:
             x, y = _pad_cycle(x, y, pad)
         sp = self.mesh_spec.sp
@@ -316,6 +324,13 @@ class JAXEstimator:
             x_sharding = NamedSharding(mesh, P("dp", "sp"))
         else:
             x_sharding = self.data_sharding
+        if n_proc > 1:
+            xd = jax.make_array_from_process_local_data(x_sharding, x)
+            yd = (
+                jax.make_array_from_process_local_data(self.data_sharding, y)
+                if y is not None else None
+            )
+            return xd, yd
         xd = jax.device_put(x, x_sharding)
         yd = jax.device_put(y, self.data_sharding) if y is not None else None
         return xd, yd
@@ -359,6 +374,7 @@ class JAXEstimator:
         evaluate_ds: Optional[MLDataset] = None,
         num_epochs: Optional[int] = None,
         resume_from: Optional[str] = None,
+        shard_rank: Optional[int] = None,
     ) -> List[Dict[str, float]]:
         """Train. ``resume_from`` names a checkpoint path (as returned by
         :meth:`save`); when it carries a mid-epoch data position
@@ -393,7 +409,11 @@ class JAXEstimator:
                 device=None,  # estimator does the (sharded) device_put
                 drop_last=self.drop_last,
             )
-            for rank in range(train_ds.num_shards)
+            for rank in (
+                range(train_ds.num_shards)
+                if shard_rank is None
+                else [shard_rank]
+            )
         ]
         rng = jax.random.PRNGKey(self.seed + 1)
         start_epoch, skip_batches = 0, 0
@@ -493,6 +513,10 @@ class JAXEstimator:
         and data resident in HBM throughout.
         """
         if self.epoch_mode == "stream":
+            return False
+        if jax.process_count() > 1:
+            # Multi-process fit streams per-rank shards; the scan path
+            # materializes the WHOLE dataset per process.
             return False
         try:
             n_rows = train_ds.total_rows
@@ -741,6 +765,9 @@ class JAXEstimator:
         if self._state is None:
             raise RuntimeError("nothing to save; call fit() first")
         path = _ckpt_path(checkpoint_dir, step)
+        if jax.process_count() > 1 and jax.process_index() != 0:
+            # dp-replicated state: rank 0's checkpoint is the checkpoint.
+            return str(path)
         epoch, batch = data_position if data_position is not None else (-1, -1)
         ckptr = ocp.StandardCheckpointer()
         ckptr.save(

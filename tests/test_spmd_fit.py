@@ -1,0 +1,92 @@
+"""Multi-process distributed fit: a gang of processes joins
+jax.distributed, each feeds its shard, gradients psum over the global dp
+axis (the framework's Ray-Train-multi-worker counterpart)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+import raydp_tpu
+import raydp_tpu.dataframe as rdf
+from raydp_tpu.data import MLDataset
+from raydp_tpu.train.spmd_fit import fit_spmd
+
+
+def _factory():
+    # Returned from a function so cloudpickle serializes it by VALUE
+    # (module-level test functions pickle by reference to a module the
+    # gang ranks cannot import).
+    def make_estimator():
+        # Runs INSIDE each rank after jax.distributed init.
+        import jax
+        import optax
+
+        from raydp_tpu.models import MLP
+        from raydp_tpu.parallel import MeshSpec
+        from raydp_tpu.train import JAXEstimator
+
+        return JAXEstimator(
+            model=MLP(hidden=(16,), out_dim=1),
+            optimizer=optax.adam(3e-2),
+            loss="mse",
+            num_epochs=10,
+            batch_size=128,
+            feature_columns=["a", "b"],
+            label_column="y",
+            mesh=MeshSpec(dp=len(jax.devices())),
+            seed=0,
+            shuffle=False,
+            epoch_mode="stream",
+        )
+
+    return make_estimator
+
+
+_make_estimator = _factory()
+
+
+def _ds(n=1024, shards=2):
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal(n)
+    b = rng.standard_normal(n)
+    y = 2 * a - 3 * b + 1
+    pdf = pd.DataFrame({"a": a, "b": b, "y": y})
+    return rdf.from_pandas(pdf, num_partitions=shards * 2), pdf
+
+
+def test_fit_spmd_in_memory():
+    df, _ = _ds()
+    ds = MLDataset.from_df(df, num_shards=2)
+    out = fit_spmd(
+        _make_estimator, ds, world_size=2, env={"JAX_PLATFORMS": "cpu"}
+    )
+    history = out["history"]
+    assert history[-1]["train_loss"] < history[0]["train_loss"]
+    assert history[-1]["train_loss"] < 1.0
+    assert out["params"] is not None
+    # every rank saw the same (replicated) global loss each epoch
+    for other in out["per_rank_history"][1:]:
+        for h0, h1 in zip(history, other):
+            np.testing.assert_allclose(
+                h0["train_loss"], h1["train_loss"], rtol=1e-5
+            )
+
+
+def test_fit_spmd_store_backed():
+    session = raydp_tpu.init(app_name="spmd-fit", num_workers=2)
+    try:
+        df, _ = _ds()
+        ds = MLDataset.from_df(df, num_shards=2)
+        out = fit_spmd(
+            _make_estimator, ds, world_size=2, env={"JAX_PLATFORMS": "cpu"}
+        )
+        history = out["history"]
+        assert history[-1]["train_loss"] < history[0]["train_loss"]
+    finally:
+        raydp_tpu.stop()
+
+
+def test_fit_spmd_world_size_mismatch():
+    df, _ = _ds()
+    ds = MLDataset.from_df(df, num_shards=2)
+    with pytest.raises(ValueError, match="num_shards == world_size"):
+        fit_spmd(_make_estimator, ds, world_size=4)
