@@ -648,6 +648,35 @@ class GroupedData:
     def count(self) -> DataFrame:
         return self.agg(("*", "count"))
 
+    def applyInPandas(self, fn: Callable) -> DataFrame:
+        """Grouped-map: hash-exchange so each physical partition holds
+        whole groups, then run ``fn(group_pdf) -> pdf`` per group (the
+        pyspark ``GroupedData.applyInPandas`` surface the reference's
+        users rely on)."""
+        import pandas as pd
+
+        keys = self.keys
+        df = self.df._exchange_by_keys(keys)
+
+        def stage(t: pa.Table) -> pa.Table:
+            if t.num_rows == 0:
+                return t
+            pdf = t.to_pandas()
+            outs = [
+                fn(group.reset_index(drop=True))
+                for _, group in pdf.groupby(keys, sort=False, dropna=False)
+            ]
+            outs = [o for o in outs if o is not None and len(o)]
+            if not outs:
+                return pa.table({})
+            return pa.Table.from_pandas(
+                pd.concat(outs, ignore_index=True), preserve_index=False
+            )
+
+        return df._with(stage)
+
+    apply_in_pandas = applyInPandas
+
     def agg(self, *aggs: Union[Tuple[str, str], Dict[str, str]]) -> DataFrame:
         specs: List[Tuple[str, str]] = []
         for a in aggs:

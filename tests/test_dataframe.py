@@ -393,3 +393,44 @@ def test_agg_fanout_scales_beyond_old_cap():
     assert np.allclose(out["sum(v)"].to_numpy(), exp["v"].to_numpy())
     # fan-out followed the executor's default, not the old hard cap of 8
     assert agg.num_partitions > 8 or df._executor.default_fanout() <= 8
+
+
+def test_groupby_apply_in_pandas():
+    import numpy as np
+    import pandas as pd
+
+    rng = np.random.default_rng(8)
+    pdf = pd.DataFrame(
+        {"k": rng.integers(0, 5, 400), "v": rng.standard_normal(400)}
+    )
+
+    def center(g):
+        g = g.copy()
+        g["v"] = g["v"] - g["v"].mean()
+        return g
+
+    out = (
+        rdf.from_pandas(pdf, num_partitions=4)
+        .groupBy("k")
+        .applyInPandas(center)
+        .to_pandas()
+    )
+    assert len(out) == 400
+    means = out.groupby("k")["v"].mean()
+    assert np.allclose(means, 0.0, atol=1e-12)
+
+    # fn may aggregate (return fewer rows) or drop groups (None/empty)
+    def summarize(g):
+        if g["k"].iloc[0] == 0:
+            return None
+        return pd.DataFrame({"k": [g["k"].iloc[0]], "n": [len(g)]})
+
+    out2 = (
+        rdf.from_pandas(pdf, num_partitions=4)
+        .groupBy("k")
+        .applyInPandas(summarize)
+        .to_pandas()
+        .sort_values("k")
+    )
+    exp = pdf[pdf.k != 0].groupby("k").size()
+    assert out2["n"].tolist() == exp.tolist()
