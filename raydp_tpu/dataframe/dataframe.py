@@ -701,7 +701,11 @@ class GroupedData:
                 partial_specs.append((col_name, "sumsq"))
                 partial_specs.append((col_name, "count"))
             elif op in _DISTINCT_OPS:
-                partial_specs.append((col_name, "distinct"))
+                partial_specs.append((col_name, "cdistinct"))
+            elif op in ("collect_list", "collect_set"):
+                partial_specs.append(
+                    (col_name, "list" if op == "collect_list" else "distinct")
+                )
             elif op == "count":
                 partial_specs.append((col_name, "count"))
             elif op in self._MERGEABLE:
@@ -732,11 +736,15 @@ class GroupedData:
                 return t
             merge_specs = []
             rename = {}
-            distinct_partials = []
+            list_partials = []  # (partial_name, final_arrow_op)
             for c, op in partial_specs:
                 p = _partial_name(c, op)
-                if op == "distinct":
-                    distinct_partials.append(p)
+                if op == "cdistinct":
+                    list_partials.append((p, "count_distinct"))
+                elif op == "distinct":
+                    list_partials.append((p, "distinct"))
+                elif op == "list":
+                    list_partials.append((p, "list"))
                 else:
                     merge_specs.append((p, mergeable[op]))
                     rename[f"{p}_{mergeable[op]}"] = p
@@ -744,10 +752,13 @@ class GroupedData:
             merged = merged.rename_columns(
                 [rename.get(c, c) for c in merged.column_names]
             )
-            # Distinct partials are list columns; flatten them back to
-            # (key, value) rows, re-distinct, and join onto the merged
-            # aggregates (arrow's hash_list can't nest lists).
-            for p in distinct_partials:
+            # List/distinct partials are list columns; flatten them back
+            # to (key, value) rows, re-aggregate, and join onto the merged
+            # aggregates (arrow's hash_list can't nest lists). Note an
+            # arrow join rejects list payloads, so count_distinct reduces
+            # to an int before the join while collect_* joins the rebuilt
+            # list via a manual index join.
+            for p, final in list_partials:
                 col = t.column(p).combine_chunks()
                 flat = pc.list_flatten(col)
                 parents = pc.list_parent_indices(col)
@@ -755,14 +766,28 @@ class GroupedData:
                     {**{k: pc.take(t.column(k), parents) for k in keys},
                      p: flat}
                 )
-                sub_agg = sub.group_by(keys).aggregate(
-                    [(p, "count_distinct")]
-                )
+                sub_agg = sub.group_by(keys).aggregate([(p, final)])
                 sub_agg = sub_agg.rename_columns(
-                    [p if c == f"{p}_count_distinct" else c
+                    [p if c == f"{p}_{final}" else c
                      for c in sub_agg.column_names]
                 )
-                merged = _join_aligned(merged, sub_agg, keys, "left outer")
+                # Arrow joins reject list payloads (and would also have to
+                # run before any previously-appended list column): align
+                # by key tuple in python — group counts, not rows.
+                order = {
+                    tuple(row[k] for k in keys): i
+                    for i, row in enumerate(
+                        sub_agg.select(keys).to_pylist()
+                    )
+                }
+                values = sub_agg.column(p)
+                idx = [
+                    order[tuple(row[k] for k in keys)]
+                    for row in merged.select(keys).to_pylist()
+                ]
+                merged = merged.append_column(
+                    p, values.take(pa.array(idx, type=pa.int64()))
+                )
             return _finalize_agg(merged, keys, specs)
 
         parts = df._executor.exchange(df._parts, splitter, n_out, combine)
@@ -928,11 +953,18 @@ def _local_agg(
             arrow_aggs.append((sq_name, "sum"))
             names.append(f"{sq_name}_sum")
         else:
-            arrow_aggs.append((col_name, op))
-            names.append(f"{col_name}_{op}")
+            arrow_op = "distinct" if op == "cdistinct" else op
+            arrow_aggs.append((col_name, arrow_op))
+            names.append(f"{col_name}_{arrow_op}")
     out = t.group_by(keys).aggregate(arrow_aggs)
-    rename = dict(zip(names, [_partial_name(c, op) for c, op in specs]))
-    return out.rename_columns([rename.get(c, c) for c in out.column_names])
+    # Positional rename: pyarrow emits key columns first, then one output
+    # per aggregation IN ORDER (duplicate names possible when two partials
+    # lower to the same arrow op, e.g. collect_set + count_distinct).
+    n_keys = len(out.column_names) - len(arrow_aggs)
+    new_names = list(out.column_names[:n_keys]) + [
+        _partial_name(c, op) for c, op in specs
+    ]
+    return out.rename_columns(new_names)
 
 
 def _finalize_agg(
@@ -966,8 +998,13 @@ def _finalize_agg(
         elif op in _DISTINCT_OPS:
             # merged column is already the per-group distinct count
             # (partition lists flattened + re-counted in combine).
-            col = merged.column(_partial_name(col_name, "distinct"))
+            col = merged.column(_partial_name(col_name, "cdistinct"))
             arrays[f"{op}({col_name})"] = pc.cast(col, pa.int64())
+        elif op in ("collect_list", "collect_set"):
+            partial = "list" if op == "collect_list" else "distinct"
+            arrays[f"{op}({col_name})"] = merged.column(
+                _partial_name(col_name, partial)
+            )
         elif op == "count":
             arrays["count" if col_name == "*" else f"count({col_name})"] = (
                 merged.column(_partial_name(col_name, "count"))

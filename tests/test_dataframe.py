@@ -434,3 +434,24 @@ def test_groupby_apply_in_pandas():
     )
     exp = pdf[pdf.k != 0].groupby("k").size()
     assert out2["n"].tolist() == exp.tolist()
+
+
+def test_agg_collect_list_and_set():
+    import pandas as pd
+
+    pdf = pd.DataFrame(
+        {"k": [0, 0, 0, 1, 1, 2], "v": [3, 3, 1, 5, 5, 9]}
+    )
+    out = (
+        rdf.from_pandas(pdf, num_partitions=3)
+        .groupBy("k")
+        .agg({"v": "collect_list"}, ("v", "collect_set"), ("v", "count_distinct"))
+        .to_pandas()
+        .sort_values("k")
+        .reset_index(drop=True)
+    )
+    lists = [sorted(x) for x in out["collect_list(v)"]]
+    assert lists == [[1, 3, 3], [5, 5], [9]]
+    sets = [sorted(x) for x in out["collect_set(v)"]]
+    assert sets == [[1, 3], [5], [9]]
+    assert out["count_distinct(v)"].tolist() == [2, 1, 1]
