@@ -648,19 +648,19 @@ class GroupedData:
     def count(self) -> DataFrame:
         return self.agg(("*", "count"))
 
-    def applyInPandas(self, fn: Callable) -> DataFrame:
+    def applyInPandas(self, fn: Callable, schema=None) -> DataFrame:
         """Grouped-map: hash-exchange so each physical partition holds
         whole groups, then run ``fn(group_pdf) -> pdf`` per group (the
-        pyspark ``GroupedData.applyInPandas`` surface the reference's
-        users rely on)."""
+        pyspark ``GroupedData.applyInPandas`` surface; pyspark likewise
+        takes an output schema). ``schema`` (pa.Schema) fixes the output
+        schema — pass it whenever ``fn`` CHANGES the columns, or
+        group-less partitions would surface the input schema."""
         import pandas as pd
 
         keys = self.keys
         df = self.df._exchange_by_keys(keys)
 
         def stage(t: pa.Table) -> pa.Table:
-            if t.num_rows == 0:
-                return t
             pdf = t.to_pandas()
             outs = [
                 fn(group.reset_index(drop=True))
@@ -668,10 +668,16 @@ class GroupedData:
             ]
             outs = [o for o in outs if o is not None and len(o)]
             if not outs:
-                return pa.table({})
-            return pa.Table.from_pandas(
+                # Empty output must still carry the OUTPUT schema.
+                if schema is not None:
+                    return schema.empty_table()
+                return t.slice(0, 0)
+            out = pa.Table.from_pandas(
                 pd.concat(outs, ignore_index=True), preserve_index=False
             )
+            if schema is not None:
+                out = out.select(schema.names).cast(schema)
+            return out
 
         return df._with(stage)
 
@@ -940,22 +946,18 @@ def _local_agg(
         t = t.append_column(
             _ROWS_COL, pa.array(np.ones(t.num_rows, dtype=np.int64))
         )
-    names = []
     for col_name, op in specs:
         if col_name == "*":
             arrow_aggs.append((_ROWS_COL, "sum"))
-            names.append(f"{_ROWS_COL}_sum")
         elif op == "sumsq":
             sq_name = f"__sq_{col_name}"
             if sq_name not in t.column_names:
                 x = pc.cast(t.column(col_name), pa.float64())
                 t = t.append_column(sq_name, pc.multiply(x, x))
             arrow_aggs.append((sq_name, "sum"))
-            names.append(f"{sq_name}_sum")
         else:
             arrow_op = "distinct" if op == "cdistinct" else op
             arrow_aggs.append((col_name, arrow_op))
-            names.append(f"{col_name}_{arrow_op}")
     out = t.group_by(keys).aggregate(arrow_aggs)
     # Positional rename: pyarrow emits key columns first, then one output
     # per aggregation IN ORDER (duplicate names possible when two partials

@@ -374,7 +374,6 @@ class JAXEstimator:
         evaluate_ds: Optional[MLDataset] = None,
         num_epochs: Optional[int] = None,
         resume_from: Optional[str] = None,
-        shard_rank: Optional[int] = None,
     ) -> List[Dict[str, float]]:
         """Train. ``resume_from`` names a checkpoint path (as returned by
         :meth:`save`); when it carries a mid-epoch data position
@@ -409,11 +408,7 @@ class JAXEstimator:
                 device=None,  # estimator does the (sharded) device_put
                 drop_last=self.drop_last,
             )
-            for rank in (
-                range(train_ds.num_shards)
-                if shard_rank is None
-                else [shard_rank]
-            )
+            for rank in range(train_ds.num_shards)
         ]
         rng = jax.random.PRNGKey(self.seed + 1)
         start_epoch, skip_batches = 0, 0
@@ -765,9 +760,9 @@ class JAXEstimator:
         if self._state is None:
             raise RuntimeError("nothing to save; call fit() first")
         path = _ckpt_path(checkpoint_dir, step)
-        if jax.process_count() > 1 and jax.process_index() != 0:
-            # dp-replicated state: rank 0's checkpoint is the checkpoint.
-            return str(path)
+        # Multi-process (fit_spmd): EVERY rank must enter orbax's save —
+        # its multihost sync barriers hang if any process skips — and
+        # orbax itself writes only on the primary host.
         epoch, batch = data_position if data_position is not None else (-1, -1)
         ckptr = ocp.StandardCheckpointer()
         ckptr.save(

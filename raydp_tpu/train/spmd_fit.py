@@ -86,38 +86,13 @@ def fit_spmd(
             from raydp_tpu.data.ml_dataset import MLDataset
 
             if store_mode:
-                plan = payload
-                from raydp_tpu.train.torch_estimator import _materialize_plan
-
-                # Reuse the rank-side store materializer to pull this
-                # rank's block slices; rebuild a single-shard dataset.
-                import pyarrow as pa
-
-                from raydp_tpu.cluster.rpc import RpcClient
-                from raydp_tpu.store.object_store import (
-                    DEFAULT_NODE,
-                    ObjectStore,
+                from raydp_tpu.train.torch_estimator import (
+                    resolve_plan_tables,
                 )
-                from raydp_tpu.store.resolver import ObjectResolver
 
-                client = RpcClient(master, "raydp.AppMaster")
-                store = ObjectStore(namespace=namespace, node_id=DEFAULT_NODE)
-
-                def meta(object_id):
-                    reply = client.call(
-                        "GetObjectMeta", {"object_id": object_id}
-                    )
-                    return reply.get("ref"), reply.get("agent")
-
-                resolver = ObjectResolver(store, meta)
-                tables = []
-                cache = {}
-                for s in plan:
-                    t = cache.get(s.block_index)
-                    if t is None:
-                        t = resolver.get_arrow_table(blocks[s.block_index])
-                        cache[s.block_index] = t
-                    tables.append(t.slice(s.offset, s.num_samples))
+                tables = resolve_plan_tables(
+                    master, namespace, blocks, payload
+                )
             else:
                 tables = payload
             shard_ds = MLDataset(list(tables), num_shards=1)

@@ -425,10 +425,15 @@ def test_groupby_apply_in_pandas():
             return None
         return pd.DataFrame({"k": [g["k"].iloc[0]], "n": [len(g)]})
 
+    import pyarrow as pa
+
     out2 = (
         rdf.from_pandas(pdf, num_partitions=4)
         .groupBy("k")
-        .applyInPandas(summarize)
+        .applyInPandas(
+            summarize,
+            schema=pa.schema([("k", pa.int64()), ("n", pa.int64())]),
+        )
         .to_pandas()
         .sort_values("k")
     )
@@ -455,3 +460,25 @@ def test_agg_collect_list_and_set():
     sets = [sorted(x) for x in out["collect_set(v)"]]
     assert sets == [[1, 3], [5], [9]]
     assert out["count_distinct(v)"].tolist() == [2, 1, 1]
+
+
+def test_apply_in_pandas_schema_survives_empty_partitions():
+    import pandas as pd
+    import pyarrow as pa
+
+    # 1 group, many shuffle partitions -> most partitions hold no groups;
+    # downstream ops on fn-output columns must still resolve.
+    pdf = pd.DataFrame({"k": [1] * 50, "v": range(50)})
+    schema = pa.schema([("k", pa.int64()), ("n", pa.int64())])
+
+    def agg(g):
+        return pd.DataFrame({"k": [g["k"].iloc[0]], "n": [len(g)]})
+
+    out = (
+        rdf.from_pandas(pdf, num_partitions=4)
+        .groupBy("k")
+        .applyInPandas(agg, schema=schema)
+        .withColumn("n2", rdf.col("n") * 2)
+        .to_pandas()
+    )
+    assert out["n2"].tolist() == [100]
