@@ -484,6 +484,57 @@ def bench_ingest():
     }
 
 
+# ----------------------------------------------------------- ETL shuffle
+
+def bench_etl_groupby():
+    """Distributed groupBy/agg throughput on the multi-process cluster
+    (ETL is the reference's core business; the shuffle rides the native
+    hash partitioner)."""
+    import pandas as pd
+
+    import raydp_tpu
+    import raydp_tpu.dataframe as rdf
+
+    n_rows = 500_000 if _CPU_FALLBACK else 2_000_000
+    rng = np.random.RandomState(9)
+    pdf = pd.DataFrame(
+        {
+            "k": rng.randint(0, 10_000, n_rows),
+            "v": rng.randn(n_rows),
+            "w": rng.randn(n_rows),
+        }
+    )
+    session = raydp_tpu.init(app_name="bench-etl", num_workers=4)
+    try:
+        df = rdf.from_pandas(pdf, num_partitions=8)
+        # warm (page cache, worker pools)
+        df.groupBy("k").agg({"v": "sum"}).count()
+        t0 = time.perf_counter()
+        out = (
+            df.groupBy("k")
+            .agg({"v": "sum"}, ("v", "mean"), ("w", "max"))
+            .to_pandas()
+        )
+        dt = time.perf_counter() - t0
+        assert len(out) == pdf["k"].nunique()
+        ours = n_rows / dt
+    finally:
+        raydp_tpu.stop()
+
+    t0 = time.perf_counter()
+    pdf.groupby("k").agg({"v": ["sum", "mean"], "w": "max"})
+    base = n_rows / (time.perf_counter() - t0)
+    import os
+
+    return {
+        "rows_per_sec": round(ours, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(ours / base, 3),
+        "host_cpus": os.cpu_count(),
+        "baseline": "single-process pandas groupby.agg (in-memory)",
+    }
+
+
 # ----------------------------------------------------------- main
 
 def _accelerator_reachable(timeout: float = 180.0) -> bool:
@@ -525,6 +576,7 @@ def main():
     # host-memory pressure the big-model configs leave behind.
     for name, fn in [
         ("ingest_device_feed", bench_ingest),
+        ("etl_groupby_shuffle", bench_etl_groupby),
         ("nyctaxi_mlp", bench_nyctaxi),
         ("titanic_classifier", bench_titanic),
         ("bert_glue", bench_bert),

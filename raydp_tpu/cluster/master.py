@@ -30,7 +30,13 @@ from raydp_tpu.store.object_store import DEFAULT_NODE, OWNER_HOLDER, ObjectRef
 logger = logging.getLogger(__name__)
 
 SERVICE = "raydp.AppMaster"
-HEARTBEAT_TIMEOUT_S = 10.0
+# Generous by design: local crashes are detected instantly via the
+# cluster's proc.poll() monitor, so the heartbeat path only covers hung
+# or remote workers — and a CPU-saturated host (big shuffle on few
+# cores) must not read as death.
+HEARTBEAT_TIMEOUT_S = float(
+    __import__("os").environ.get("RAYDP_TPU_HEARTBEAT_TIMEOUT", "45")
+)
 
 
 @dataclass

@@ -85,7 +85,12 @@ class Worker:
         self.worker_id = worker_id
         self.node_id = node_id
         self.resources = resources
-        self.master = RpcClient(master_address, "raydp.AppMaster")
+        # Generous default timeout: control RPCs (RegisterObject) must
+        # survive a driver process saturated by a big shuffle on a small
+        # host — a slow master is not a dead master.
+        self.master = RpcClient(
+            master_address, "raydp.AppMaster", timeout=120.0
+        )
         self.store: ObjectStore = None  # namespace learned at registration
         self.ctx: WorkerContext = None
         self._stop_event = threading.Event()
@@ -166,13 +171,14 @@ class Worker:
         missed = 0
         while not self._stop_event.wait(2.0):
             reply = self.master.try_call(
-                "Heartbeat", {"worker_id": self.worker_id}, timeout=5.0
+                "Heartbeat", {"worker_id": self.worker_id}, timeout=8.0
             )
             if reply is None:
-                # Transient master hiccups are absorbed (the master-side
-                # timeout is 10s); only a sustained outage means exit.
+                # Transient master hiccups — including a driver process
+                # saturated by a big shuffle on a small host — are
+                # absorbed; only a sustained outage means exit.
                 missed += 1
-                if missed >= 3:
+                if missed >= 8:
                     logger.warning(
                         "worker %s: master unreachable for %d beats; exiting",
                         self.worker_id, missed,
