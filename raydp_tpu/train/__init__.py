@@ -1,4 +1,5 @@
 from raydp_tpu.train.estimator import JAXEstimator, TrainingCallback
+from raydp_tpu.train.spmd_fit import fit_spmd
 from raydp_tpu.train.losses import LOSSES, METRICS, resolve_loss, resolve_metric
 from raydp_tpu.train.tf_estimator import TFEstimator
 from raydp_tpu.train.torch_estimator import TorchEstimator
@@ -8,6 +9,7 @@ __all__ = [
     "TorchEstimator",
     "TFEstimator",
     "TrainingCallback",
+    "fit_spmd",
     "LOSSES",
     "METRICS",
     "resolve_loss",
