@@ -122,6 +122,25 @@ class MLDataset:
         )
 
     @staticmethod
+    def from_refs(
+        refs: Sequence[ObjectRef],
+        num_shards: int,
+        shuffle: bool = False,
+        shuffle_seed: Optional[int] = None,
+        rank_nodes: Optional[List[str]] = None,
+    ) -> "MLDataset":
+        """Directly from ObjectRefs (parity with the reference's
+        ``ray.data.from_arrow_refs`` entry, dataset.py:470-480). Resolves
+        through the live session's node-aware resolver."""
+        from raydp_tpu.context import require_session
+
+        session = require_session()
+        return MLDataset(
+            list(refs), num_shards, shuffle, shuffle_seed,
+            store=session.cluster.resolver, rank_nodes=rank_nodes,
+        )
+
+    @staticmethod
     def from_parquet(
         paths: Union[str, Sequence[str]],
         num_shards: int,

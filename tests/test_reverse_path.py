@@ -99,3 +99,14 @@ def test_refs_survive_into_new_frame_after_worker_churn(session):
     session.cluster.kill_worker(victim)
     out = rdf.from_refs(refs).to_pandas().sort_values("i").reset_index(drop=True)
     pd.testing.assert_frame_equal(out, pdf)
+
+
+def test_mldataset_from_refs(session):
+    pdf = _typed_pdf(100)
+    refs = rdf.from_pandas(pdf, num_partitions=2).to_object_refs()
+    ds = MLDataset.from_refs(refs, num_shards=2)
+    total = sum(
+        len(ds.shard_columns(r, ["i"])["i"]) for r in range(2)
+    )
+    assert total == 2 * ds.rows_per_shard
+    assert ds.total_rows == 100
