@@ -537,6 +537,21 @@ class DataFrame:
             outs.append(df._with(fn))
         return outs
 
+    def sample(self, fraction: float, seed: Optional[int] = None) -> "DataFrame":
+        """Bernoulli row sample (Spark ``df.sample``); same
+        process-stable content-keyed draw as random_split so repeated
+        passes see identical uniforms."""
+        if not 0.0 <= fraction <= 1.0:
+            raise ValueError("fraction must be in [0, 1]")
+        seed = secrets.randbits(31) if seed is None else seed
+        df = self._flush()
+
+        def fn(t: pa.Table) -> pa.Table:
+            rng = np.random.default_rng(seed + _table_fingerprint(t))
+            return t.filter(pa.array(rng.random(t.num_rows) < fraction))
+
+        return df._with(fn)
+
     # -- actions --------------------------------------------------------
     def collect_partitions(self) -> List[pa.Table]:
         df = self._flush()

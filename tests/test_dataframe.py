@@ -482,3 +482,17 @@ def test_apply_in_pandas_schema_survives_empty_partitions():
         .to_pandas()
     )
     assert out["n2"].tolist() == [100]
+
+
+def test_sample_fraction():
+    import pandas as pd
+
+    pdf = pd.DataFrame({"x": range(10_000)})
+    df = rdf.from_pandas(pdf, num_partitions=4)
+    s = df.sample(0.3, seed=5)
+    n = s.count()
+    assert 2500 < n < 3500
+    # deterministic: same seed, same rows
+    assert s.count() == df.sample(0.3, seed=5).count()
+    assert df.sample(0.0, seed=1).count() == 0
+    assert df.sample(1.0, seed=1).count() == 10_000
