@@ -20,6 +20,7 @@ unchanged. Disconnecting never tears the remote cluster down.
 """
 from __future__ import annotations
 
+import itertools
 import logging
 import os
 import threading
@@ -147,6 +148,10 @@ class RemoteCluster:
         self._workers_stamp = 0.0
         self._lock = threading.RLock()
         self._resolver: Optional[ObjectResolver] = None
+        # Round-robin cursor for unpinned tasks (parity with the in-process
+        # Cluster._pick_worker): without it every attempt-0 submit lands on
+        # workers[0] and client drivers load one worker.
+        self._rr = itertools.count()
 
     # -- object access --------------------------------------------------
     @property
@@ -197,6 +202,7 @@ class RemoteCluster:
             import grpc
 
             preferred = worker_id
+            rr = next(self._rr)
             last: Optional[BaseException] = None
             for attempt in range(retries + 1):
                 workers = self.alive_workers()
@@ -210,7 +216,7 @@ class RemoteCluster:
                         last = ClientError("no alive workers")
                         time.sleep(0.3 * (attempt + 1))
                         continue
-                    target = workers[attempt % len(workers)]
+                    target = workers[(rr + attempt) % len(workers)]
                 client = self._worker_client(target)
                 try:
                     reply = client.call("RunTask", payload, timeout=timeout)

@@ -783,6 +783,12 @@ class GroupedData:
                 col = t.column(p).combine_chunks()
                 flat = pc.list_flatten(col)
                 parents = pc.list_parent_indices(col)
+                # Spark's collect_list/collect_set/count_distinct all ignore
+                # nulls; arrow's hash_list keeps them — drop here so an
+                # all-null group falls through to the default-fill below.
+                valid = pc.is_valid(flat)
+                flat = flat.filter(valid)
+                parents = parents.filter(valid)
                 sub = pa.table(
                     {**{k: pc.take(t.column(k), parents) for k in keys},
                      p: flat}
@@ -795,15 +801,40 @@ class GroupedData:
                 # Arrow joins reject list payloads (and would also have to
                 # run before any previously-appended list column): align
                 # by key tuple in python — group counts, not rows.
+                # NaN keys: two float('nan') pylist values are distinct
+                # dict keys (NaN != NaN, id-based hash), while arrow's
+                # hash_aggregate groups them together — normalize to a
+                # sentinel so a NaN group with real values matches its
+                # aggregate instead of silently taking the empty default.
+                def _key_of(row):
+                    return tuple(
+                        "__raydp_nan__"
+                        if isinstance(row[k], float) and row[k] != row[k]
+                        else row[k]
+                        for k in keys
+                    )
+
                 order = {
-                    tuple(row[k] for k in keys): i
+                    _key_of(row): i
                     for i, row in enumerate(
                         sub_agg.select(keys).to_pylist()
                     )
                 }
-                values = sub_agg.column(p)
+                values = sub_agg.column(p).combine_chunks()
+                # A group whose values are ALL null is absent from sub_agg
+                # (arrow's hash_distinct/hash_list partials drop nulls), so
+                # a plain order[...] lookup KeyErrors. Map missing groups to
+                # an appended default: 0 for count_distinct, [] for
+                # collect_list/collect_set — matching Spark's semantics.
+                default = (
+                    pa.array([0], type=values.type)
+                    if final == "count_distinct"
+                    else pa.array([[]], type=values.type)
+                )
+                values = pa.concat_arrays([values, default])
+                missing_idx = len(order)
                 idx = [
-                    order[tuple(row[k] for k in keys)]
+                    order.get(_key_of(row), missing_idx)
                     for row in merged.select(keys).to_pylist()
                 ]
                 merged = merged.append_column(

@@ -80,6 +80,7 @@ class JAXEstimator:
         logical_rules: Optional[Sequence] = None,
         aux_losses: bool = False,
         max_failures: int = 3,
+        donate_state: Optional[bool] = None,
         save_every_steps: int = 0,
         self_supervised: bool = False,
         prefetch: int = 2,
@@ -137,6 +138,16 @@ class JAXEstimator:
         self.epoch_mode = epoch_mode
         self.scan_threshold_bytes = scan_threshold_bytes
         self.max_failures = max_failures
+        # Buffer donation and step-level retry are mutually exclusive: once
+        # a donated dispatch consumes the state, re-invoking the step with
+        # it raises "Buffer deleted or donated" — every retry would fail
+        # instantly and mask the original error (ADVICE r2). Default:
+        # donate only when retries are disabled; donate_state=True opts
+        # back into donation (big-model memory win) and turns a step
+        # failure into an immediate, honest raise.
+        self.donate_state = (
+            (max_failures == 0) if donate_state is None else bool(donate_state)
+        )
         self.save_every_steps = save_every_steps
         # Self-supervised (language-modeling) mode: no label column; the
         # loss consumes the inputs as targets (e.g. loss="lm_ce" trains a
@@ -288,7 +299,9 @@ class JAXEstimator:
                 out[name] = fn(preds, target)
             return out
 
-        self._train_step = jax.jit(train_step, donate_argnums=0)
+        self._train_step = jax.jit(
+            train_step, donate_argnums=(0,) if self.donate_state else ()
+        )
         self._eval_step = jax.jit(eval_step)
 
     def _model_takes_deterministic(self) -> bool:
@@ -462,6 +475,12 @@ class JAXEstimator:
                             # Transient device/runtime errors re-run the
                             # same batch; persistent ones exhaust the
                             # budget and surface.
+                            if self.donate_state:
+                                # The failed dispatch consumed the donated
+                                # state buffers — a retry cannot succeed.
+                                # Surface the ORIGINAL error instead of
+                                # burning the budget on "Buffer donated".
+                                raise
                             failures += 1
                             if failures > self.max_failures:
                                 raise
@@ -601,7 +620,11 @@ class JAXEstimator:
             state, losses = jax.lax.scan(body, state, xs_in)
             return state, losses.mean()
 
-        return jax.jit(epoch_fn, donate_argnums=0)
+        # Honor donate_state here too: with donation off a callback may
+        # safely hold a reference to the previous epoch's state.
+        return jax.jit(
+            epoch_fn, donate_argnums=(0,) if self.donate_state else ()
+        )
 
     def _fit_scan(
         self,
@@ -808,13 +831,28 @@ class JAXEstimator:
             "data_batch": np.asarray(0, dtype=np.int64),
         }
         ckptr = ocp.StandardCheckpointer()
-        try:
-            restored = ckptr.restore(path, skeleton)
-        except BaseException:
-            # Legacy checkpoints (pre data-position) lack the two keys.
+        # Legacy checkpoints (pre data-position) lack the data_epoch/
+        # data_batch keys. Detect by inspecting the checkpoint's own tree
+        # metadata rather than retry-on-failure, so a genuinely corrupt
+        # checkpoint surfaces its real error instead of a misleading
+        # missing-key one (ADVICE r2).
+        has_position = _ckpt_has_keys(path, ("data_epoch", "data_batch"))
+        if has_position is False:
             skeleton.pop("data_epoch")
             skeleton.pop("data_batch")
             restored = ckptr.restore(path, skeleton)
+        elif has_position:
+            restored = ckptr.restore(path, skeleton)
+        else:
+            # Metadata unreadable (older orbax layout): fall back to the
+            # retry heuristic, but never swallow KeyboardInterrupt/
+            # SystemExit.
+            try:
+                restored = ckptr.restore(path, skeleton)
+            except Exception:
+                skeleton.pop("data_epoch")
+                skeleton.pop("data_batch")
+                restored = ckptr.restore(path, skeleton)
         epoch = int(restored.get("data_epoch", -1))
         batch = int(restored.get("data_batch", -1))
         self._resume_position = (epoch, batch) if epoch >= 0 else None
@@ -877,3 +915,33 @@ def _ckpt_path(checkpoint_dir: str, step: Optional[int]):
 
     name = f"step_{step}" if step is not None else "final"
     return os.path.abspath(os.path.join(checkpoint_dir, name))
+
+
+def _ckpt_has_keys(path: str, keys) -> Optional[bool]:
+    """Whether the orbax checkpoint at ``path`` contains all top-level
+    ``keys``, read from its ``_METADATA`` tree metadata. None = metadata
+    missing/unreadable (caller decides how to proceed)."""
+    import json
+    import os
+
+    meta = os.path.join(path, "_METADATA")
+    try:
+        with open(meta) as f:
+            tree_meta = json.load(f).get("tree_metadata", {})
+    except (OSError, ValueError):
+        return None
+    if not isinstance(tree_meta, dict) or not tree_meta:
+        return None
+    present = set()
+    try:
+        for entry in tree_meta.values():
+            key_meta = (
+                entry.get("key_metadata") if isinstance(entry, dict) else None
+            )
+            if key_meta:
+                present.add(key_meta[0].get("key"))
+    except (AttributeError, IndexError, TypeError):
+        return None  # unexpected per-entry schema: treat as unreadable
+    if not present:
+        return None  # extracted nothing — schema we don't understand
+    return all(k in present for k in keys)

@@ -150,10 +150,13 @@ def _clamp_to_true(padded: List[int], total: int) -> List[int]:
         (i for i, (n, t) in enumerate(zip(padded, out)) if t < n), None
     )
     if first_short is not None:
-        assert all(t == 0 for t in out[first_short + 1:]), (
-            "divide_blocks padding layout changed; true-size clamp "
-            f"misattributes rows: padded={padded} true={out}"
-        )
+        if not all(t == 0 for t in out[first_short + 1:]):
+            # A real error, not an assert: under ``python -O`` an assert
+            # vanishes and eval rows get silently misattributed.
+            raise RuntimeError(
+                "divide_blocks padding layout changed; true-size clamp "
+                f"misattributes rows: padded={padded} true={out}"
+            )
     return out
 
 

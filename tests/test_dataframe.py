@@ -496,3 +496,37 @@ def test_sample_fraction():
     assert s.count() == df.sample(0.3, seed=5).count()
     assert df.sample(0.0, seed=1).count() == 0
     assert df.sample(1.0, seed=1).count() == 10_000
+
+
+def test_agg_distinct_all_null_group():
+    """A group whose values are ALL null must not KeyError (ADVICE r2):
+    Spark returns count_distinct=0 and collect_set=[] for such groups."""
+    import pandas as pd
+
+    pdf = pd.DataFrame(
+        {
+            "k": ["a", "a", "b", "b", "c"],
+            "v": [1.0, 2.0, None, None, 3.0],
+        }
+    )
+    df = rdf.from_pandas(pdf, num_partitions=2)
+    out = (
+        df.groupBy("k")
+        .agg({"v": "count_distinct"})
+        .to_pandas()
+        .sort_values("k")
+        .reset_index(drop=True)
+    )
+    assert out["count_distinct(v)"].tolist() == [2, 0, 1]
+
+    sets = (
+        df.groupBy("k")
+        .agg({"v": "collect_set"}, ("v", "collect_list"))
+        .to_pandas()
+        .sort_values("k")
+        .reset_index(drop=True)
+    )
+    assert sorted(sets["collect_set(v)"][0]) == [1.0, 2.0]
+    assert list(sets["collect_set(v)"][1]) == []
+    assert list(sets["collect_list(v)"][1]) == []
+    assert list(sets["collect_list(v)"][2]) == [3.0]
