@@ -17,6 +17,7 @@ DataFrame/MLDataset → JAXEstimator path on the visible accelerator.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -509,21 +510,26 @@ def bench_etl_groupby():
         df = rdf.from_pandas(pdf, num_partitions=8)
         # warm (page cache, worker pools)
         df.groupBy("k").agg({"v": "sum"}).count()
-        t0 = time.perf_counter()
-        out = (
-            df.groupBy("k")
-            .agg({"v": "sum"}, ("v", "mean"), ("w", "max"))
-            .to_pandas()
-        )
-        dt = time.perf_counter() - t0
+        dt = float("inf")
+        for _ in range(3):  # best-of-3: single-run noise on shared hosts
+            t0 = time.perf_counter()
+            out = (
+                df.groupBy("k")
+                .agg({"v": "sum"}, ("v", "mean"), ("w", "max"))
+                .to_pandas()
+            )
+            dt = min(dt, time.perf_counter() - t0)
         assert len(out) == pdf["k"].nunique()
         ours = n_rows / dt
     finally:
         raydp_tpu.stop()
 
-    t0 = time.perf_counter()
-    pdf.groupby("k").agg({"v": ["sum", "mean"], "w": "max"})
-    base = n_rows / (time.perf_counter() - t0)
+    db = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        pdf.groupby("k").agg({"v": ["sum", "mean"], "w": "max"})
+        db = min(db, time.perf_counter() - t0)
+    base = n_rows / db
     import os
 
     return {
@@ -535,24 +541,114 @@ def bench_etl_groupby():
     }
 
 
+def bench_etl_window():
+    """Window-function throughput (the reference's DLRM preprocessing
+    idiom: row_number().over(partitionBy(...).orderBy(desc(...))) —
+    examples/pytorch_dlrm.ipynb assign_id_with_window), plus a running
+    sum, against the equivalent single-process pandas transforms."""
+    import pandas as pd
+
+    import raydp_tpu
+    import raydp_tpu.dataframe as rdf
+    from raydp_tpu.dataframe import window as W
+
+    n_rows = 400_000 if _CPU_FALLBACK else 1_500_000
+    rng = np.random.RandomState(11)
+    pdf = pd.DataFrame(
+        {
+            "g": rng.randint(0, 5_000, n_rows),
+            "v": rng.randn(n_rows),
+            "t": rng.randint(0, 1_000_000, n_rows),
+        }
+    )
+    session = raydp_tpu.init(app_name="bench-window", num_workers=4)
+    try:
+        df = rdf.from_pandas(pdf, num_partitions=8)
+        w = W.Window.partitionBy("g").orderBy(W.desc("t"))
+        df.withColumn("r", W.row_number().over(w)).count()  # warm
+        dt = float("inf")
+        for _ in range(3):  # best-of-3: single-run noise on shared hosts
+            t0 = time.perf_counter()
+            out = (
+                df.withColumn("r", W.row_number().over(w))
+                .withColumn("rsum", W.window_sum("v").over(w))
+                .to_pandas()
+            )
+            dt = min(dt, time.perf_counter() - t0)
+        assert len(out) == n_rows
+        ours = n_rows / dt
+    finally:
+        raydp_tpu.stop()
+
+    db = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        spdf = pdf.sort_values(["g", "t"], ascending=[True, False])
+        grouped = spdf.groupby("g", sort=False)
+        spdf.assign(r=grouped.cumcount() + 1, rsum=grouped["v"].cumsum())
+        db = min(db, time.perf_counter() - t0)
+    base = n_rows / db
+
+    return {
+        "rows_per_sec": round(ours, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(ours / base, 3),
+        "host_cpus": os.cpu_count(),
+        "baseline": "single-process pandas sort+groupby cumulative ops",
+    }
+
+
 # ----------------------------------------------------------- main
 
-def _accelerator_reachable(timeout: float = 180.0) -> bool:
+def _accelerator_reachable(
+    probe_timeout: float = 180.0,
+    total_budget: float = 1800.0,
+    retry_wait: float = 150.0,
+) -> bool:
     """Probe TPU-client creation in a SUBPROCESS: the plugin's pool
     handshake can wedge indefinitely (e.g. a stale chip claim from a
     killed process), and a hung bench is worse than a CPU-fallback
-    bench. The probe process is killable; this process never is."""
+    bench. The probe process is killable; this process never is.
+
+    The known failure mode (wedged plugin tunnel) is TRANSIENT and
+    recovers over tens of minutes, so one failed probe must not condemn
+    the whole run to CPU numbers: retry every ~2.5 min for up to 30 min
+    (override with RAYDP_TPU_PROBE_BUDGET_S; 0 = single attempt) before
+    falling back."""
     import subprocess
 
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            capture_output=True,
-            timeout=timeout,
+    budget = float(os.environ.get("RAYDP_TPU_PROBE_BUDGET_S", total_budget))
+    deadline = time.monotonic() + budget
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                capture_output=True,
+                timeout=probe_timeout,
+            )
+            if proc.returncode == 0:
+                return True
+            # Fast non-zero exit = permanent config problem (no plugin,
+            # broken install): retrying won't help, fall back now.
+            print(
+                "WARNING: accelerator probe failed hard (non-timeout); "
+                "falling back to CPU",
+                file=sys.stderr,
+            )
+            return False
+        except subprocess.TimeoutExpired:
+            pass  # the transient wedged-tunnel mode: worth retrying
+        remaining = deadline - time.monotonic()
+        print(
+            f"WARNING: accelerator probe attempt {attempt} timed out; "
+            f"{max(remaining, 0):.0f}s of probe budget left",
+            file=sys.stderr,
         )
-        return proc.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+        if remaining <= retry_wait:
+            return False
+        time.sleep(retry_wait)
 
 
 def main():
@@ -577,6 +673,7 @@ def main():
     for name, fn in [
         ("ingest_device_feed", bench_ingest),
         ("etl_groupby_shuffle", bench_etl_groupby),
+        ("etl_window", bench_etl_window),
         ("nyctaxi_mlp", bench_nyctaxi),
         ("titanic_classifier", bench_titanic),
         ("bert_glue", bench_bert),

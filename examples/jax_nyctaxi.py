@@ -45,7 +45,9 @@ def main():
     from raydp_tpu.models.mlp import taxi_fare_regressor
     from raydp_tpu.train import JAXEstimator
 
-    session = raydp_tpu.init(app_name="jax-nyctaxi", num_workers=2)
+    # num_workers intentionally NOT hardcoded: raydp-tpu-submit's
+    # --num-workers (RAYDP_TPU_NUM_WORKERS) controls it, default 2.
+    session = raydp_tpu.init(app_name="jax-nyctaxi")
     try:
         df = nyc_taxi_preprocess(
             rdf.from_pandas(synthetic_taxi(n_rows), num_partitions=4)

@@ -104,6 +104,34 @@ class Cluster:
             target=self._elastic_loop, name="raydp-elastic", daemon=True
         )
         self._elastic_thread.start()
+        self._warm_workers_async()
+
+    def _warm_workers_async(self) -> None:
+        """Pre-import the ETL stack on every worker in the background.
+
+        A worker's first dataframe task otherwise pays the pandas/pyarrow
+        import chain inside the first query (hundreds of ms, multiplied
+        when all workers cold-start concurrently on a small host). Fire-
+        and-forget: results are dropped, failures are harmless (a dead
+        worker surfaces through the elastic loop, not here)."""
+
+        def _warm(ctx):
+            import pandas  # noqa: F401
+
+            import raydp_tpu.dataframe.dataframe  # noqa: F401
+
+            return True
+
+        def _fire():
+            try:
+                for w in self.alive_workers():
+                    self.submit_async(_warm, worker_id=w.worker_id)
+            except Exception:  # pragma: no cover - warmup is best-effort
+                pass
+
+        threading.Thread(
+            target=_fire, name="raydp-warmup", daemon=True
+        ).start()
 
     def _elastic_loop(self) -> None:
         """Crash recovery (reference: executor reschedule on disconnect,

@@ -10,10 +10,30 @@ the holder outlives the cluster).
 from __future__ import annotations
 
 import atexit
+import os
 import threading
 from typing import Any, Dict, Optional
 
 from raydp_tpu.config import ClusterConfig
+
+
+def _env_default(name: str, explicit, default):
+    """Explicit argument > RAYDP_TPU_* environment (the submit CLI's
+    handoff, cli/submit.py; reference: bin/raydp-submit conf plumbing) >
+    built-in default."""
+    if explicit is not None:
+        return explicit
+    val = os.environ.get(name)
+    return val if val is not None else default
+
+
+def _env_confs() -> Dict[str, str]:
+    prefix = "RAYDP_TPU_CONF_"
+    return {
+        k[len(prefix):]: v
+        for k, v in os.environ.items()
+        if k.startswith(prefix)
+    }
 
 _lock = threading.RLock()
 _session: Optional["Session"] = None
@@ -55,10 +75,10 @@ class Session:
 
 
 def init(
-    app_name: str = "raydp-tpu",
-    num_workers: int = 2,
-    cores_per_worker: int = 1,
-    memory_per_worker: "int | str" = "1GB",
+    app_name: Optional[str] = None,
+    num_workers: Optional[int] = None,
+    cores_per_worker: Optional[int] = None,
+    memory_per_worker: "int | str | None" = None,
     placement_strategy: Optional[str] = None,
     placement_group: Optional[Any] = None,
     placement_bundle_indexes: Optional[list] = None,
@@ -85,12 +105,24 @@ def init(
             )
         if _session is not None and not _session._holder_released:
             _lingering.append(_session)
+        merged_confs = _env_confs()
+        merged_confs.update(configs or {})
         cfg = ClusterConfig.from_args(
-            app_name=app_name,
-            num_workers=num_workers,
-            cores_per_worker=cores_per_worker,
-            memory_per_worker=memory_per_worker,
-            placement_strategy=placement_strategy,
+            app_name=_env_default("RAYDP_TPU_APP_NAME", app_name, "raydp-tpu"),
+            num_workers=int(
+                _env_default("RAYDP_TPU_NUM_WORKERS", num_workers, 2)
+            ),
+            cores_per_worker=int(
+                _env_default(
+                    "RAYDP_TPU_CORES_PER_WORKER", cores_per_worker, 1
+                )
+            ),
+            memory_per_worker=_env_default(
+                "RAYDP_TPU_MEMORY_PER_WORKER", memory_per_worker, "1GB"
+            ),
+            placement_strategy=_env_default(
+                "RAYDP_TPU_PLACEMENT_STRATEGY", placement_strategy, None
+            ),
             placement_group=placement_group,
             placement_bundle_indexes=placement_bundle_indexes,
             enable_native=enable_native,
@@ -100,7 +132,7 @@ def init(
             advertise_host=advertise_host,
             master_port=master_port,
             launcher=launcher,
-            configs=configs,
+            configs=merged_confs,
         )
         _session = Session(cfg)
         return _session

@@ -48,15 +48,24 @@ class DataFrame:
         # Keys this frame is currently hash-partitioned on (co-located
         # groups); lets chained window ops on one spec skip re-shuffles.
         self._exchange_keys: Optional[tuple] = None
+        # Lazy small-data coalesce (adaptive exchange): when set, _flush
+        # concatenates all partitions in ONE task and runs the pending
+        # pipeline there — fusing the gather with the next stage instead
+        # of paying an extra store round-trip for an eager concat.
+        self._pending_gather = False
 
     # -- plan helpers ---------------------------------------------------
     def _with(self, fn: Callable[[pa.Table], pa.Table]) -> "DataFrame":
-        return DataFrame(self._parts, self._executor, self._pending + [fn])
+        out = DataFrame(self._parts, self._executor, self._pending + [fn])
+        out._pending_gather = self._pending_gather
+        return out
 
     def _flush(self) -> "DataFrame":
         """Run the pending narrow pipeline; afterwards partitions are
         materialized results."""
-        if not self._pending:
+        if not self._pending and not (
+            self._pending_gather and len(self._parts) > 1
+        ):
             return self
         pipeline = list(self._pending)
 
@@ -65,7 +74,14 @@ class DataFrame:
                 table = fn(table)
             return table
 
-        parts = self._executor.map_partitions(self._parts, run)
+        if self._pending_gather and len(self._parts) > 1:
+
+            def gathered(tables: List[pa.Table]) -> pa.Table:
+                return run(_concat(tables))
+
+            parts = [self._executor.run_coalesced(self._parts, gathered)]
+        else:
+            parts = self._executor.map_partitions(self._parts, run)
         out = DataFrame(parts, self._executor)
         out._exchange_keys = self._exchange_keys  # rows did not move
         return out
@@ -188,6 +204,17 @@ class DataFrame:
         if n_out == 1:
             df._exchange_keys = tuple(keys)  # trivially co-located
             return df
+        # Adaptive coalesce (Spark AQE shuffle-partition coalescing):
+        # below the threshold one concatenated partition trivially
+        # satisfies "whole groups co-located" at a fraction of the
+        # exchange's task/IPC cost. LAZY: the concat fuses into the next
+        # stage's task (no intermediate store round-trip).
+        total_bytes = sum(df._executor.part_nbytes(p) for p in df._parts)
+        if total_bytes <= _EXCHANGE_COALESCE_BYTES:
+            out = DataFrame(df._parts, df._executor)
+            out._pending_gather = True
+            out._exchange_keys = tuple(keys)
+            return out
 
         def splitter(t: pa.Table) -> List[pa.Table]:
             if t.num_rows == 0:
@@ -736,6 +763,25 @@ class GroupedData:
         partial_specs = list(dict.fromkeys(partial_specs))
 
         df = self.df._flush()
+        # -- adaptive plan (Spark AQE-style, sized from partition stats) --
+        # Tier 1: small input + ops arrow can finalize in one pass → ONE
+        # task running arrow's hash aggregation (internally multithreaded).
+        # A process-level exchange on data this size would spend more on
+        # task orchestration + IPC than on aggregation.
+        total_bytes = sum(
+            df._executor.part_nbytes(p) for p in df._parts
+        )
+        if total_bytes <= _AGG_COALESCE_BYTES and _direct_agg_supported(specs):
+            keys_ = list(keys)
+            specs_ = list(specs)
+
+            def direct(tables: List[pa.Table]) -> pa.Table:
+                from raydp_tpu.dataframe.executor import _concat
+
+                return _direct_agg(_concat(tables), keys_, specs_)
+
+            part = df._executor.run_coalesced(df._parts, direct)
+            return DataFrame([part], df._executor)
         # Fan-out scales with the cluster (the old hard cap of 8 was a
         # scaling cliff — VERDICT r1 weak 6).
         n_out = max(
@@ -745,8 +791,10 @@ class GroupedData:
         # would drag the executor (locks, sockets) into cloudpickle.
         mergeable = dict(self._MERGEABLE)
 
+        def partial_fn(t: pa.Table) -> pa.Table:
+            return _local_agg(t, keys, partial_specs)
+
         def splitter(t: pa.Table) -> List[pa.Table]:
-            t = _local_agg(t, keys, partial_specs)
             if t.num_rows == 0:
                 return [t] * n_out
             bucket = _hash_bucket(t, keys, n_out)
@@ -842,7 +890,26 @@ class GroupedData:
                 )
             return _finalize_agg(merged, keys, specs)
 
-        parts = df._executor.exchange(df._parts, splitter, n_out, combine)
+        # Tier 2/3: map-side partial aggregation first (shrinks the data
+        # to ~groups × partitions rows), THEN size the shuffle from the
+        # measured partial sizes: small partials merge in one task; big
+        # ones hash-exchange across the full fan-out.
+        partials = df._executor.map_partitions(df._parts, partial_fn)
+        partial_bytes = sum(
+            df._executor.part_nbytes(p) for p in partials
+        )
+        if partial_bytes <= _COMBINE_COALESCE_BYTES or n_out == 1:
+
+            def merge_all(tables: List[pa.Table]) -> pa.Table:
+                from raydp_tpu.dataframe.executor import _concat
+
+                return combine(_concat(tables))
+
+            part = df._executor.run_coalesced(partials, merge_all)
+            df._executor.discard(partials)
+            return DataFrame([part], df._executor)
+        parts = df._executor.exchange(partials, splitter, n_out, combine)
+        df._executor.discard(partials)
         return DataFrame(parts, df._executor)
 
 
@@ -979,6 +1046,92 @@ _ROWS_COL = "__rows__"
 
 _STAT_OPS = ("stddev", "std", "stddev_samp", "variance", "var", "var_samp")
 _DISTINCT_OPS = ("count_distinct", "countDistinct", "approx_count_distinct")
+
+
+def _env_bytes(name: str, default: int) -> int:
+    import os
+
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+# Adaptive-shuffle thresholds (Spark AQE's advisoryPartitionSizeInBytes
+# analog). Below _AGG_COALESCE_BYTES of INPUT, aggregation runs as one
+# arrow pass in one task; below _COMBINE_COALESCE_BYTES of measured
+# PARTIAL size, the merge phase runs in one task instead of a hash
+# exchange. Arrow's hash aggregation threads internally, so the single
+# task still uses every core of its host.
+_AGG_COALESCE_BYTES = _env_bytes("RAYDP_TPU_AGG_COALESCE_BYTES", 128 << 20)
+_COMBINE_COALESCE_BYTES = _env_bytes(
+    "RAYDP_TPU_COMBINE_COALESCE_BYTES", 64 << 20
+)
+_EXCHANGE_COALESCE_BYTES = _env_bytes(
+    "RAYDP_TPU_EXCHANGE_COALESCE_BYTES", 32 << 20
+)
+
+
+def _direct_agg_supported(specs: List[Tuple[str, str]]) -> bool:
+    """Ops arrow's hash aggregation can finalize in ONE pass. collect_*
+    need the flatten/re-aggregate dance (null-dropping list semantics),
+    so they always take the two-phase path."""
+    return all(op not in ("collect_list", "collect_set") for _, op in specs)
+
+
+def _direct_agg(
+    t: pa.Table, keys: List[str], specs: List[Tuple[str, str]]
+) -> pa.Table:
+    """Single-pass arrow aggregation producing FINAL output columns.
+
+    Semantics match the two-phase _local_agg → combine → _finalize_agg
+    pipeline (null-skipping aggregates, ddof=1 stats per Spark), minus
+    its orchestration: used by the adaptive tier-1 plan on small inputs.
+    """
+    arrow_aggs = []
+    out_names: List[str] = []
+    if any(c == "*" for c, _ in specs):
+        t = t.append_column(
+            _ROWS_COL, pa.array(np.ones(t.num_rows, dtype=np.int64))
+        )
+    for col_name, op in specs:
+        if col_name == "*":
+            arrow_aggs.append((_ROWS_COL, "sum"))
+            out_names.append("count")
+        elif op in ("mean", "avg"):
+            arrow_aggs.append((col_name, "mean"))
+            out_names.append(f"{op}({col_name})")
+        elif op in _STAT_OPS:
+            kind = (
+                "stddev" if op.startswith(("stddev", "std")) else "variance"
+            )
+            arrow_aggs.append(
+                (col_name, kind, pc.VarianceOptions(ddof=1))
+            )
+            out_names.append(f"{op}({col_name})")
+        elif op in _DISTINCT_OPS:
+            arrow_aggs.append((col_name, "count_distinct"))
+            out_names.append(f"{op}({col_name})")
+        elif op == "count":
+            arrow_aggs.append((col_name, "count"))
+            out_names.append(f"count({col_name})")
+        elif op in GroupedData._MERGEABLE and op != "sumsq":
+            arrow_aggs.append((col_name, op))
+            out_names.append(f"{op}({col_name})")
+        else:
+            raise ValueError(f"unsupported aggregation {op!r}")
+    agged = t.group_by(keys).aggregate(arrow_aggs)
+    n_keys = len(agged.column_names) - len(arrow_aggs)
+    arrays = {
+        k: agged.column(i)
+        for i, k in enumerate(agged.column_names[:n_keys])
+    }
+    for j, name in enumerate(out_names):
+        col = agged.column(n_keys + j)
+        if name.split("(")[0] in _DISTINCT_OPS:
+            col = pc.cast(col, pa.int64())
+        arrays[name] = col
+    return pa.table(arrays)
 
 
 def _local_agg(

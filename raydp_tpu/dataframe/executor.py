@@ -49,6 +49,26 @@ class Executor:
         concatenate chunk i across partitions into output partition i."""
         raise NotImplementedError
 
+    def part_nbytes(self, part: Any) -> int:
+        """Approximate in-memory/wire size of one partition, WITHOUT
+        materializing it — drives adaptive shuffle planning (Spark AQE's
+        coalescing decisions read shuffle statistics the same way)."""
+        raise NotImplementedError
+
+    def discard(self, parts: List[Any]) -> None:
+        """Free intermediate partitions (shuffle temps). No-op where
+        partitions are plain in-memory tables."""
+
+    def run_coalesced(
+        self, parts: List[Any], fn: Callable[[List[pa.Table]], pa.Table]
+    ) -> Any:
+        """Run ``fn`` over ALL partitions in one task and return a single
+        output partition. The adaptive small-data plan: when inputs (or
+        partial-agg outputs) are small, one arrow kernel pass — which
+        parallelizes internally across cores — beats a process-level
+        hash exchange whose per-task orchestration would dominate."""
+        raise NotImplementedError
+
     def materialize(self, part: Any) -> pa.Table:
         raise NotImplementedError
 
@@ -106,6 +126,12 @@ class LocalExecutor(Executor):
             merged = _concat([chunks[i] for chunks in chunked])
             outs.append(combine(merged) if combine else merged)
         return outs
+
+    def part_nbytes(self, part):
+        return part.nbytes
+
+    def run_coalesced(self, parts, fn):
+        return fn(list(parts))
 
     def materialize(self, part):
         return part
@@ -183,6 +209,40 @@ class ClusterExecutor(Executor):
             for i, ref in enumerate(parts)
         ]
         return [f.result() for f in futures]
+
+    def part_nbytes(self, part):
+        return part.size if isinstance(part, ObjectRef) else part.nbytes
+
+    def discard(self, parts):
+        for ref in parts:
+            if isinstance(ref, ObjectRef):
+                self.store.delete(ref)
+
+    def run_coalesced(self, parts, fn):
+        def task(ctx, refs):
+            tables = [ctx.get_table(r) for r in refs]
+            return ctx.put_table(fn(tables), holder=True)
+
+        # Locality: run on the worker whose node holds the most input
+        # bytes (one cross-node fetch per remote partition either way;
+        # majority-resident placement minimizes them).
+        by_node = {}
+        for ref in parts:
+            if isinstance(ref, ObjectRef):
+                by_node[ref.node_id] = by_node.get(ref.node_id, 0) + ref.size
+        worker_id = None
+        if by_node:
+            best = max(by_node, key=by_node.get)
+            workers = sorted(
+                w.worker_id
+                for w in self.cluster.alive_workers()
+                if w.node_id == best
+            )
+            if workers:
+                worker_id = workers[0]
+        return self.cluster.submit_async(
+            task, list(parts), worker_id=worker_id
+        ).result()
 
     def exchange(self, parts, splitter, n_out, combine=None):
         def split_task(ctx, ref):

@@ -141,12 +141,14 @@ class JAXEstimator:
         # Buffer donation and step-level retry are mutually exclusive: once
         # a donated dispatch consumes the state, re-invoking the step with
         # it raises "Buffer deleted or donated" — every retry would fail
-        # instantly and mask the original error (ADVICE r2). Default:
-        # donate only when retries are disabled; donate_state=True opts
-        # back into donation (big-model memory win) and turns a step
-        # failure into an immediate, honest raise.
+        # instantly and mask the original error (ADVICE r2). Donation
+        # stays ON by default (the big-model memory win; turning it off
+        # by default would roughly double peak state memory for every
+        # existing caller): a donated step failure raises the ORIGINAL
+        # error immediately. Pass donate_state=False to make the
+        # max_failures retry budget effective.
         self.donate_state = (
-            (max_failures == 0) if donate_state is None else bool(donate_state)
+            True if donate_state is None else bool(donate_state)
         )
         self.save_every_steps = save_every_steps
         # Self-supervised (language-modeling) mode: no label column; the

@@ -131,3 +131,104 @@ def test_client_refs_visible_to_owning_driver(session):
 def test_connect_guard_in_process_with_live_session(session):
     with pytest.raises(RuntimeError, match="already active"):
         raydp_tpu.connect(session.cluster.master.address)
+
+
+# Estimator + MLDataset parity over both driver modes (reference runs its
+# whole suite under direct AND ray:// client modes, conftest.py:42-49).
+FIT_PIPELINE = """
+def run_fit():
+    import numpy as np
+    import pandas as pd
+    import flax.linen as nn
+    import raydp_tpu.dataframe as rdf
+    from raydp_tpu.train import JAXEstimator
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(1)(nn.relu(nn.Dense(8)(x)))
+
+    rng = np.random.default_rng(1)
+    pdf = pd.DataFrame({
+        "a": rng.standard_normal(512),
+        "b": rng.standard_normal(512),
+    })
+    pdf["y"] = 2.0 * pdf.a - pdf.b
+    est = JAXEstimator(
+        MLP(), num_epochs=4, batch_size=64,
+        feature_columns=["a", "b"], label_column="y", seed=7,
+    )
+    hist = est.fit_on_df(
+        rdf.from_pandas(pdf, num_partitions=2), num_shards=2
+    )
+    return {
+        "first": float(hist[0]["train_loss"]),
+        "last": float(hist[-1]["train_loss"]),
+        "epochs": len(hist),
+    }
+"""
+
+ROUNDTRIP_PIPELINE = """
+def run_roundtrip():
+    import numpy as np
+    import pandas as pd
+    import raydp_tpu.dataframe as rdf
+    from raydp_tpu.data import MLDataset
+
+    pdf = pd.DataFrame({
+        "x": np.arange(300, dtype=np.int64),
+        "y": np.arange(300, dtype=np.float64) * 0.5,
+    })
+    df = rdf.from_pandas(pdf, num_partitions=3)
+    ds = MLDataset.from_df(df, num_shards=2)
+    back = ds.to_df().to_pandas().sort_values("x").reset_index(drop=True)
+    return {
+        "rows": int(len(back)),
+        "x_sum": int(back["x"].sum()),
+        "y_sum": float(back["y"].sum()),
+        "shards": int(ds.num_shards),
+    }
+"""
+
+
+def _run_in_mode(session, mode, pipeline, fn_name):
+    if mode == "direct":
+        ns = {}
+        exec(pipeline, ns)
+        return ns[fn_name]()
+    addr = session.cluster.master.address
+    script = (
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "import json, raydp_tpu\n"
+        f"s = raydp_tpu.connect({addr!r})\n"
+        + pipeline
+        + f"\nout = {fn_name}()\n"
+        "raydp_tpu.stop()\n"
+        "print('RESULT ' + json.dumps(out))\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True, text=True, timeout=300,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    line = next(
+        l for l in proc.stdout.splitlines() if l.startswith("RESULT ")
+    )
+    return json.loads(line[len("RESULT "):])
+
+
+@pytest.mark.parametrize("mode", ["direct", "client"])
+def test_estimator_fit_both_driver_modes(session, mode):
+    out = _run_in_mode(session, mode, FIT_PIPELINE, "run_fit")
+    assert out["epochs"] == 4
+    assert out["last"] < out["first"], out  # loss must decrease
+
+
+@pytest.mark.parametrize("mode", ["direct", "client"])
+def test_ml_dataset_roundtrip_both_driver_modes(session, mode):
+    out = _run_in_mode(session, mode, ROUNDTRIP_PIPELINE, "run_roundtrip")
+    assert out["rows"] == 300
+    assert out["x_sum"] == sum(range(300))
+    assert abs(out["y_sum"] - sum(range(300)) * 0.5) < 1e-9
+    assert out["shards"] == 2

@@ -530,3 +530,55 @@ def test_agg_distinct_all_null_group():
     assert list(sets["collect_set(v)"][1]) == []
     assert list(sets["collect_list(v)"][1]) == []
     assert list(sets["collect_list(v)"][2]) == [3.0]
+
+
+@pytest.mark.parametrize("tier", ["direct", "coalesced_combine", "exchange"])
+def test_agg_adaptive_tiers_parity(monkeypatch, tier):
+    """The three adaptive agg plans (single-pass arrow, partial+single
+    combine, partial+hash exchange) must produce identical results."""
+    import numpy as np
+    import pandas as pd
+
+    import raydp_tpu.dataframe.dataframe as dfmod
+
+    if tier == "direct":
+        monkeypatch.setattr(dfmod, "_AGG_COALESCE_BYTES", 1 << 40)
+    elif tier == "coalesced_combine":
+        monkeypatch.setattr(dfmod, "_AGG_COALESCE_BYTES", 0)
+        monkeypatch.setattr(dfmod, "_COMBINE_COALESCE_BYTES", 1 << 40)
+    else:
+        monkeypatch.setattr(dfmod, "_AGG_COALESCE_BYTES", 0)
+        monkeypatch.setattr(dfmod, "_COMBINE_COALESCE_BYTES", 0)
+
+    rng = np.random.RandomState(3)
+    pdf = pd.DataFrame(
+        {
+            "k": rng.randint(0, 50, 5000),
+            "v": np.where(rng.rand(5000) < 0.1, np.nan, rng.randn(5000)),
+            "w": rng.randint(0, 7, 5000).astype(float),
+        }
+    )
+    out = (
+        rdf.from_pandas(pdf, num_partitions=4)
+        .groupBy("k")
+        .agg(
+            {"v": "sum"},
+            ("v", "mean"),
+            ("v", "stddev"),
+            ("w", "count_distinct"),
+            ("v", "count"),
+            ("*", "count"),
+            ("w", "max"),
+        )
+        .to_pandas()
+        .sort_values("k")
+        .reset_index(drop=True)
+    )
+    g = pdf.groupby("k")
+    assert np.allclose(out["sum(v)"], g["v"].sum())
+    assert np.allclose(out["mean(v)"], g["v"].mean())
+    assert np.allclose(out["stddev(v)"], g["v"].std())
+    assert out["count_distinct(w)"].tolist() == g["w"].nunique().tolist()
+    assert out["count(v)"].tolist() == g["v"].count().tolist()
+    assert out["count"].tolist() == g.size().tolist()
+    assert np.allclose(out["max(w)"], g["w"].max())

@@ -118,7 +118,9 @@ def test_resume_from_epoch_checkpoint(tmp_path):
 
 
 def test_step_retry_budget_surfaces_persistent_failure():
-    est = _est(max_failures=2)
+    # Retries require donation OFF (a donated state cannot be re-fed to
+    # the step after a failed dispatch).
+    est = _est(max_failures=2, donate_state=False)
     ds = _ds()
 
     calls = {"n": 0}
@@ -139,3 +141,28 @@ def test_step_retry_budget_surfaces_persistent_failure():
     with pytest.raises(Boom):
         est.fit(ds)
     assert calls["n"] >= 3
+
+
+def test_donated_step_failure_raises_original_immediately():
+    """Default (donation ON): a step failure surfaces the ORIGINAL error
+    on the first attempt — no budget burned on impossible retries
+    (ADVICE r2: retrying a donated step can only mask the root cause)."""
+    est = _est(max_failures=2)  # donate_state defaults True
+    assert est.donate_state is True
+    ds = _ds()
+
+    calls = {"n": 0}
+
+    class Boom(Exception):
+        pass
+
+    def bad_step(state, x, y, rng):
+        calls["n"] += 1
+        raise Boom("original")
+
+    est._init_state(np.zeros((1, 2), dtype=np.float32))
+    est._train_step = bad_step
+    est._build_steps = lambda: None
+    with pytest.raises(Boom, match="original"):
+        est.fit(ds)
+    assert calls["n"] == 1

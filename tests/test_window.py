@@ -184,22 +184,34 @@ def test_chained_windows_exchange_once():
     pdf = pd.DataFrame({"g": ["a", "b"] * 8, "v": list(range(16))})
     df = rdf.from_pandas(pdf, num_partitions=4)
     calls = []
-    orig = type(df._executor).exchange
+    orig_exchange = type(df._executor).exchange
+    orig_coalesced = type(df._executor).run_coalesced
 
-    def counting(self, *a, **k):
-        calls.append(1)
-        return orig(self, *a, **k)
+    def counting_exchange(self, *a, **k):
+        calls.append("exchange")
+        return orig_exchange(self, *a, **k)
+
+    def counting_coalesced(self, *a, **k):
+        calls.append("coalesced")
+        return orig_coalesced(self, *a, **k)
 
     w = Window.partitionBy("g").orderBy("v")
     import unittest.mock as mock
 
-    with mock.patch.object(type(df._executor), "exchange", counting):
+    # Small data takes the adaptive coalesce instead of a hash exchange;
+    # either way the co-location step must run exactly ONCE for both
+    # window columns.
+    with mock.patch.object(
+        type(df._executor), "exchange", counting_exchange
+    ), mock.patch.object(
+        type(df._executor), "run_coalesced", counting_coalesced
+    ):
         out = (
             df.withColumn("r", row_number().over(w))
             .withColumn("prev", lag("v").over(w))
             .to_pandas()
         )
-    assert len(calls) == 1, f"expected 1 exchange, saw {len(calls)}"
+    assert len(calls) == 1, f"expected 1 co-location op, saw {calls}"
     a = out[out.g == "a"].sort_values("v")
     assert a.r.tolist() == list(range(1, 9))
 
@@ -405,3 +417,63 @@ def test_window_running_aggregates_with_order():
     assert np.allclose(
         out["runavg"], [5.0, 3.0, 13 / 3, 4.0, 2.0, 5.0]
     )
+
+
+def test_chained_window_reads_prior_window_column():
+    """A second window expr may reference the column the first stage
+    created (frame cache must not serve a table lacking it)."""
+    pdf = pd.DataFrame({"g": ["a", "a", "a", "b", "b"], "v": [3, 1, 2, 5, 4]})
+    df = rdf.from_pandas(pdf, num_partitions=2)
+    w = Window.partitionBy("g").orderBy("v")
+    out = (
+        df.withColumn("r", row_number().over(w))
+        .withColumn("prev_r", lag("r").over(w))
+        .to_pandas()
+        .sort_values(["g", "v"])
+        .reset_index(drop=True)
+    )
+    assert out["r"].tolist() == [1, 2, 3, 1, 2]
+    assert out["prev_r"].fillna(-1).tolist() == [-1, 1, 2, -1, 1]
+
+
+def test_window_sum_big_int64_exact_and_dtype():
+    """Null-free int64 aggregates exactly (no float64 2^53 cliff) and
+    keeps an integer dtype (review r3 findings 1/4)."""
+    big = 2**53 + 1
+    pdf = pd.DataFrame(
+        {"g": ["a", "a", "b"], "t": [1, 2, 1], "v": [big, 1, 7]}
+    )
+    df = rdf.from_pandas(pdf, num_partitions=1)
+    w = Window.partitionBy("g").orderBy("t")
+    out = (
+        df.withColumn("rs", window_sum("v").over(w))
+        .to_pandas()
+        .sort_values(["g", "t"])
+    )
+    assert out.rs.dtype.kind in "iu"
+    assert out.rs.tolist() == [big, big + 1, 7]
+    # whole-partition frame too
+    w2 = Window.partitionBy("g")
+    out2 = df.withColumn("tot", window_sum("v").over(w2)).to_pandas()
+    assert out2.tot.dtype.kind in "iu"
+    assert dict(zip(out2.g, out2.tot))["a"] == big + 1
+
+
+def test_window_sum_valid_nan_does_not_poison_running_sum():
+    """A NaN VALUE (valid, not null) is skipped like pandas' skipna
+    cumsum — it must not poison the rest of the group (review r3 #2)."""
+    pdf = pd.DataFrame(
+        {
+            "g": ["a"] * 4,
+            "t": [1, 2, 3, 4],
+            "v": [1.0, np.nan, 2.0, 3.0],
+        }
+    )
+    df = rdf.from_pandas(pdf, num_partitions=1)
+    w = Window.partitionBy("g").orderBy("t")
+    out = (
+        df.withColumn("rs", window_sum("v").over(w))
+        .to_pandas()
+        .sort_values("t")
+    )
+    assert out.rs.tolist() == [1.0, 1.0, 3.0, 6.0]
