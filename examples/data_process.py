@@ -39,17 +39,32 @@ def synthetic_taxi(n_rows: int) -> pd.DataFrame:
         rng.integers(0, 365 * 24 * 3600, n_rows), unit="s"
     )
     trip_min = rng.gamma(2.0, 7.0, n_rows)
+    pickup_lon = -73.98 + 0.1 * rng.standard_normal(n_rows)
+    pickup_lat = 40.75 + 0.1 * rng.standard_normal(n_rows)
+    dropoff_lon = -73.97 + 0.1 * rng.standard_normal(n_rows)
+    dropoff_lat = 40.76 + 0.1 * rng.standard_normal(n_rows)
+    # Fare follows the trip DISTANCE the features can reconstruct (plus a
+    # duration term and noise) — so the estimator examples actually have
+    # signal to learn, like the real NYC dataset.
+    dist_km = np.hypot(
+        (dropoff_lon - pickup_lon) * 84.3,  # km/deg at 40.75N
+        (dropoff_lat - pickup_lat) * 111.1,
+    )
     return pd.DataFrame(
         {
             "pickup_datetime": pickup,
             "dropoff_datetime": pickup + pd.to_timedelta(trip_min, unit="m"),
             "passenger_count": rng.integers(0, 7, n_rows),
-            "pickup_longitude": -73.98 + 0.1 * rng.standard_normal(n_rows),
-            "pickup_latitude": 40.75 + 0.1 * rng.standard_normal(n_rows),
-            "dropoff_longitude": -73.97 + 0.1 * rng.standard_normal(n_rows),
-            "dropoff_latitude": 40.76 + 0.1 * rng.standard_normal(n_rows),
+            "pickup_longitude": pickup_lon,
+            "pickup_latitude": pickup_lat,
+            "dropoff_longitude": dropoff_lon,
+            "dropoff_latitude": dropoff_lat,
             "fare_amount": np.maximum(
-                2.5, 2.5 + 2.0 * trip_min + rng.standard_normal(n_rows)
+                2.5,
+                2.5
+                + 1.6 * dist_km
+                + 0.3 * trip_min
+                + rng.standard_normal(n_rows),
             ),
         }
     )

@@ -1,4 +1,5 @@
 from raydp_tpu.train.estimator import JAXEstimator, TrainingCallback
+from raydp_tpu.train.gbt import GBTEstimator
 from raydp_tpu.train.spmd_fit import fit_spmd
 from raydp_tpu.train.losses import LOSSES, METRICS, resolve_loss, resolve_metric
 from raydp_tpu.train.tf_estimator import TFEstimator
@@ -8,6 +9,7 @@ __all__ = [
     "JAXEstimator",
     "TorchEstimator",
     "TFEstimator",
+    "GBTEstimator",
     "TrainingCallback",
     "fit_spmd",
     "LOSSES",

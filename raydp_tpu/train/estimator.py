@@ -315,6 +315,26 @@ class JAXEstimator:
         except (TypeError, ValueError):
             return False
 
+    def _sharded_prefetch(self, host_iter):
+        """Double-buffered sharded infeed: stage batch N+1's
+        ``_shard_batch`` (an async device_put onto the mesh) while the
+        caller's train step computes on batch N, so the chip never stalls
+        on H2D (SURVEY §7.3 "double-buffered infeed without device
+        stalls" — this was previously only on the loader's single-device
+        path the estimator didn't use). Initializes model state from the
+        first host batch before sharding it. Yields
+        ``(x_dev, y_dev, host_batch_len)``."""
+        pending = None
+        for x, y in host_iter:
+            if self._state is None:
+                self._init_state(x)
+            staged = self._shard_batch(x, y) + (len(x),)
+            if pending is not None:
+                yield pending
+            pending = staged
+        if pending is not None:
+            yield pending
+
     def _shard_batch(self, x, y):
         """Batch → mesh-sharded device arrays. The batch dim splits over
         dp; a second (sequence) dim additionally splits over sp when the
@@ -453,63 +473,66 @@ class JAXEstimator:
             # host↔device and serialize the prefetch/double-buffer pipeline.
             loss_sum = None
             n_batches, n_samples = 0, 0
-            b_idx = 0
             to_skip = skip_batches if epoch == start_epoch else 0
-            for loader in loaders:
-                for x, y in loader:
-                    if b_idx < to_skip:
-                        b_idx += 1
-                        continue
-                    if self._state is None:
-                        self._init_state(x)
-                    rng, step_rng = jax.random.split(rng)
-                    xd, yd = self._shard_batch(x, y)
-                    while True:
-                        try:
-                            self._state, loss_val = self._train_step(
-                                self._state, xd, yd, step_rng
-                            )
-                            break
-                        except Exception:
-                            # Step-level retry budget
-                            # (TrainConfig.max_failures; reference: Ray
-                            # Train max_retries, torch/estimator.py:269).
-                            # Transient device/runtime errors re-run the
-                            # same batch; persistent ones exhaust the
-                            # budget and surface.
-                            if self.donate_state:
-                                # The failed dispatch consumed the donated
-                                # state buffers — a retry cannot succeed.
-                                # Surface the ORIGINAL error instead of
-                                # burning the budget on "Buffer donated".
-                                raise
-                            failures += 1
-                            if failures > self.max_failures:
-                                raise
-                            logger.warning(
-                                "train step failed (%d/%d); retrying batch",
-                                failures, self.max_failures, exc_info=True,
-                            )
-                    loss_sum = loss_val if loss_sum is None else loss_sum + loss_val
-                    n_batches += 1
-                    b_idx += 1
-                    steps_done += 1
-                    n_samples += len(x)
-                    if (
-                        self.save_every_steps
-                        and self.checkpoint_dir
-                        and steps_done % self.save_every_steps == 0
-                    ):
-                        self.save(
-                            self.checkpoint_dir,
-                            step=f"mid_{steps_done}",
-                            data_position=(epoch, b_idx),
+            b_idx = to_skip
+
+            def host_batches():
+                skipped = 0
+                for loader in loaders:
+                    for x, y in loader:
+                        if skipped < to_skip:
+                            skipped += 1
+                            continue
+                        yield x, y
+
+            for xd, yd, blen in self._sharded_prefetch(host_batches()):
+                rng, step_rng = jax.random.split(rng)
+                while True:
+                    try:
+                        self._state, loss_val = self._train_step(
+                            self._state, xd, yd, step_rng
                         )
-                    if self.log_every and n_batches % self.log_every == 0:
-                        logger.info(
-                            "epoch %d step %d loss %.5f",
-                            epoch, n_batches, float(loss_val),  # sync: opt-in
+                        break
+                    except Exception:
+                        # Step-level retry budget
+                        # (TrainConfig.max_failures; reference: Ray
+                        # Train max_retries, torch/estimator.py:269).
+                        # Transient device/runtime errors re-run the
+                        # same batch; persistent ones exhaust the
+                        # budget and surface.
+                        if self.donate_state:
+                            # The failed dispatch consumed the donated
+                            # state buffers — a retry cannot succeed.
+                            # Surface the ORIGINAL error instead of
+                            # burning the budget on "Buffer donated".
+                            raise
+                        failures += 1
+                        if failures > self.max_failures:
+                            raise
+                        logger.warning(
+                            "train step failed (%d/%d); retrying batch",
+                            failures, self.max_failures, exc_info=True,
                         )
+                loss_sum = loss_val if loss_sum is None else loss_sum + loss_val
+                n_batches += 1
+                b_idx += 1
+                steps_done += 1
+                n_samples += blen
+                if (
+                    self.save_every_steps
+                    and self.checkpoint_dir
+                    and steps_done % self.save_every_steps == 0
+                ):
+                    self.save(
+                        self.checkpoint_dir,
+                        step=f"mid_{steps_done}",
+                        data_position=(epoch, b_idx),
+                    )
+                if self.log_every and n_batches % self.log_every == 0:
+                    logger.info(
+                        "epoch %d step %d loss %.5f",
+                        epoch, n_batches, float(loss_val),  # sync: opt-in
+                    )
             train_loss = float(loss_sum) / max(1, n_batches) if (
                 loss_sum is not None
             ) else 0.0

@@ -13,6 +13,7 @@ EXAMPLES = [
     "jax_titanic.py",
     "dlrm_criteo.py",
     "bert_glue.py",
+    "gbt_nyctaxi.py",
     "spmd_job.py",
     "pod_driver.py",
 ]
