@@ -62,6 +62,31 @@ def _param_count(params) -> int:
     return sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(params))
 
 
+def _timed_train_steps(loss_of_params, params, tx, batch, n_steps=6):
+    """Shared raw-train-step timing harness (sweep/study benches):
+    jit a value_and_grad + optax update step, run one compile/warmup
+    step, then time ``n_steps`` bracketed by block_until_ready.
+    Returns elapsed seconds for the timed steps."""
+    import jax
+    import optax
+
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state, *args):
+        loss, grads = jax.value_and_grad(loss_of_params)(params, *args)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    params, opt_state, _ = step(params, opt_state, *batch)
+    jax.block_until_ready(params)
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        params, opt_state, _ = step(params, opt_state, *batch)
+    jax.block_until_ready(params)
+    return time.perf_counter() - t0
+
+
 def _steady(history):
     """samples/s over steady-state epochs (epoch 0 pays XLA compile)."""
     steady = history[1:] or history
@@ -207,6 +232,54 @@ BERT_SEQ = 128
 BERT_BATCH = 32
 
 
+def _bert_sweep(make_cfg, batches=(32, 64, 128), impls=("dense", "flash")):
+    """Raw train-step throughput over (batch, attention impl): the MFU
+    lever the r2 verdict asked to sweep (tunnel-blocked then). Returns
+    (table, best_batch, best_impl)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from raydp_tpu.models.transformer import SequenceClassifier
+
+    rs = np.random.RandomState(0)
+    table = {}
+    best = (None, None, 0.0)
+    for impl in impls:
+        cfg = make_cfg(impl)
+        model = SequenceClassifier(cfg=cfg, num_classes=2)
+        for batch in batches:
+            ids = jnp.asarray(
+                rs.randint(0, cfg.vocab_size, size=(batch, BERT_SEQ))
+            )
+            labels = jnp.asarray(rs.randint(0, 2, size=(batch,)))
+
+            def loss_fn(p, ids, labels):
+                logits = model.apply(p, ids)
+                ll = jax.nn.log_softmax(logits.astype(jnp.float32))
+                return -jnp.mean(
+                    jnp.take_along_axis(ll, labels[:, None], axis=-1)
+                )
+
+            try:
+                params = model.init(jax.random.PRNGKey(0), ids)
+                n_steps = 6
+                dt = _timed_train_steps(
+                    loss_fn, params, optax.adamw(2e-5), (ids, labels),
+                    n_steps=n_steps,
+                )
+                rate = n_steps * batch / dt
+                table[f"{impl}_b{batch}"] = round(rate, 2)
+                if rate > best[2]:
+                    best = (batch, impl, rate)
+            except Exception as exc:
+                table[f"{impl}_b{batch}"] = (
+                    f"{type(exc).__name__}: {str(exc)[:80]}"
+                )
+            params = None
+    return table, best[0], best[1]
+
+
 def bench_bert():
     import optax
     import pyarrow as pa
@@ -215,14 +288,30 @@ def bench_bert():
     from raydp_tpu.models.transformer import SequenceClassifier, bert_base
     from raydp_tpu.train.estimator import JAXEstimator
 
+    sweep = None
+    bert_batch = BERT_BATCH
     if _CPU_FALLBACK:
         from raydp_tpu.models.transformer import tiny_transformer
 
         cfg = tiny_transformer(max_len=BERT_SEQ, dropout_rate=0.1)
     else:
         cfg = bert_base(max_len=BERT_SEQ, dropout_rate=0.1)
+        # On the real chip: find the throughput-best (batch, attention)
+        # before the estimator run, and use it.
+        sweep, best_batch, best_impl = _bert_sweep(
+            lambda impl: bert_base(
+                max_len=BERT_SEQ, dropout_rate=0.1, attention_impl=impl
+            )
+        )
+        if best_batch is not None:
+            bert_batch = best_batch
+            cfg = bert_base(
+                max_len=BERT_SEQ,
+                dropout_rate=0.1,
+                attention_impl=best_impl,
+            )
     model = SequenceClassifier(cfg=cfg, num_classes=2)
-    n_rows = 20 * BERT_BATCH
+    n_rows = 20 * bert_batch
     rs = np.random.RandomState(0)
     ids = rs.randint(0, cfg.vocab_size, size=(n_rows, BERT_SEQ)).astype(
         np.int32
@@ -237,7 +326,7 @@ def bench_bert():
         optimizer=optax.adamw(2e-5),
         loss="softmax_ce",
         num_epochs=3,
-        batch_size=BERT_BATCH,
+        batch_size=bert_batch,
         feature_columns=[f"t{i}" for i in range(BERT_SEQ)],
         label_column="label",
         feature_dtype=np.int32,
@@ -252,15 +341,20 @@ def bench_bert():
     flops_per_sample = 3 * fwd
 
     base = _bert_torch_baseline(cfg)
-    return {
+    out = {
         "samples_per_sec": round(ours, 2),
         "unit": "samples/s",
         "vs_baseline": round(ours / base, 3) if base else None,
         "mfu": _mfu(ours, flops_per_sample),
         "params": n_params,
         "seq_len": BERT_SEQ,
+        "batch": bert_batch,
+        "attention_impl": cfg.attention_impl,
         "baseline": "torch-cpu TransformerEncoder loop",
     }
+    if sweep is not None:
+        out["batch_sweep_samples_per_sec"] = sweep
+    return out
 
 
 def _bert_torch_baseline(cfg):
@@ -541,6 +635,216 @@ def bench_etl_groupby():
     }
 
 
+def bench_dlrm_embedding_study():
+    """take vs one-hot embedding lookup across vocab sizes — the
+    measurement behind models/dlrm.py AUTO_ONEHOT_THRESHOLD. Times a
+    full train step (lookup + pooled loss + grad update) per impl per
+    vocab and reports the measured crossover."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from raydp_tpu.models.dlrm import AUTO_ONEHOT_THRESHOLD, ShardedEmbedding
+
+    vocabs = (
+        [1024, 4096, 8192, 16384]
+        if _CPU_FALLBACK
+        else [1024, 4096, 8192, 32768, 131072]
+    )
+    batch = 1024 if _CPU_FALLBACK else 8192
+    embed_dim = 64
+    steps = 8
+    rs = np.random.RandomState(0)
+    results = {}
+    for vocab in vocabs:
+        per_impl = {}
+        for impl in ("take", "onehot"):
+            model = ShardedEmbedding(
+                vocab_size=vocab, embed_dim=embed_dim, impl=impl
+            )
+            ids = jnp.asarray(rs.randint(0, vocab, size=batch))
+
+            def loss_fn(p, ids):
+                emb = model.apply(p, ids)
+                return jnp.mean(jnp.square(emb.astype(jnp.float32)))
+
+            params = model.init(jax.random.PRNGKey(0), ids)
+            dt = _timed_train_steps(
+                loss_fn, params, optax.adagrad(1e-2), (ids,), n_steps=steps
+            )
+            per_impl[impl] = round(steps * batch / dt, 1)
+        results[vocab] = per_impl
+    crossover = next(
+        (
+            v
+            for v in vocabs
+            if results[v]["onehot"] >= results[v]["take"]
+        ),
+        None,
+    )
+    return {
+        "samples_per_sec_by_vocab": results,
+        "unit": "lookups/s",
+        "batch": batch,
+        "auto_threshold": AUTO_ONEHOT_THRESHOLD,
+        "measured_crossover_vocab": crossover,
+        "note": (
+            "single-chip numbers; sharded tables additionally favor "
+            "onehot (contraction partitions over tp, take would gather "
+            "cross-chip)"
+        ),
+    }
+
+
+def bench_dlrm_criteo_scale():
+    """Criteo-SCALE end-to-end: >=1M synthetic rows x 26 tables through
+    the ETL engine (cluster dataframe -> MLDataset) into a DLRM fit —
+    the full reference pipeline shape (pytorch_dlrm.ipynb) at data
+    volume, not a toy table."""
+    import optax
+    import pandas as pd
+
+    import raydp_tpu
+    import raydp_tpu.dataframe as rdf
+    from raydp_tpu.data.ml_dataset import MLDataset
+    from raydp_tpu.models.dlrm import DLRMConfig, PackedDLRM
+    from raydp_tpu.train.estimator import JAXEstimator
+
+    n_rows = 200_000 if _CPU_FALLBACK else 1_048_576
+    n_tables = 26
+    vocabs = tuple(
+        [100_000] * 8 + [10_000] * 10 + [1_000] * 8
+    ) if not _CPU_FALLBACK else tuple([10_000] * 8 + [1_000] * 18)
+    cfg = DLRMConfig(
+        vocab_sizes=vocabs, embed_dim=64, bottom_mlp=(256, 128, 64),
+        top_mlp=(512, 256, 128),
+    )
+    rs = np.random.RandomState(7)
+    dense_cols = [f"d{i}" for i in range(cfg.dense_features)]
+    sparse_cols = [f"c{i}" for i in range(n_tables)]
+    pdf = pd.DataFrame(
+        {
+            **{
+                c: rs.rand(n_rows).astype(np.float32) for c in dense_cols
+            },
+            **{
+                c: rs.randint(0, vocabs[i], n_rows).astype(np.int32)
+                for i, c in enumerate(sparse_cols)
+            },
+            "click": (rs.rand(n_rows) < 0.25).astype(np.float32),
+        }
+    )
+    session = raydp_tpu.init(app_name="bench-criteo", num_workers=4)
+    try:
+        t0 = time.perf_counter()
+        df = rdf.from_pandas(pdf, num_partitions=8)
+        # A light per-column transform so etl_seconds covers a real
+        # dataframe stage, not just ingestion (the reference notebook
+        # normalizes its dense columns at this point).
+        for c in dense_cols[:4]:
+            df = df.withColumn(c, rdf.col(c) * 2.0)
+        ds = MLDataset.from_df(df, num_shards=2)
+        etl_s = time.perf_counter() - t0
+        est = JAXEstimator(
+            model=PackedDLRM(cfg=cfg),
+            optimizer=optax.adagrad(1e-2),
+            loss="bce",
+            num_epochs=2,
+            batch_size=DLRM_BATCH,
+            feature_columns=dense_cols + sparse_cols,
+            label_column="click",
+            shuffle=False,
+            epoch_mode="stream",
+        )
+        ours = _steady(est.fit(ds))
+    finally:
+        raydp_tpu.stop()
+    return {
+        "samples_per_sec": round(ours, 1),
+        "unit": "samples/s",
+        "rows": n_rows,
+        "tables": n_tables,
+        "etl_seconds": round(etl_s, 2),
+        "vs_baseline": None,
+        "baseline": "none (scale config; dlrm_criteo carries the torch baseline)",
+    }
+
+
+def bench_longcontext():
+    """Sequence-length scaling on the live device: flash attention vs
+    the dense stack at seq 2k-16k (single chip). Records samples/s per
+    length per impl and where dense falls over (OOM / collapse) —
+    SURVEY §5.7 long-context evidence, extending the seq-2048 CPU run
+    of r2 (commit dc63ccb)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from raydp_tpu.models.transformer import CausalLM, TransformerConfig
+
+    seqs = [512, 1024] if _CPU_FALLBACK else [2048, 4096, 8192, 16384]
+    results = {}
+    for impl in ("dense", "flash"):
+        per_seq = {}
+        for seq in seqs:
+            batch = max(1, (8192 if not _CPU_FALLBACK else 2048) // seq)
+            cfg = TransformerConfig(
+                vocab_size=8192,
+                n_layers=4,
+                n_heads=8,
+                d_model=512,
+                d_ff=2048,
+                max_len=seq,
+                causal=True,
+                dropout_rate=0.0,
+                attention_impl=impl,
+                dtype=jnp.bfloat16,
+            )
+            model = CausalLM(cfg=cfg)
+            rs = np.random.RandomState(0)
+            ids = jnp.asarray(
+                rs.randint(0, cfg.vocab_size, size=(batch, seq))
+            )
+            def loss_fn(p, ids):
+                logits = model.apply(p, ids)
+                tgt = jnp.roll(ids, -1, axis=1)
+                ll = jax.nn.log_softmax(logits.astype(jnp.float32))
+                return -jnp.mean(
+                    jnp.take_along_axis(ll, tgt[..., None], axis=-1)
+                )
+
+            try:
+                params = model.init(jax.random.PRNGKey(0), ids)
+                n_steps = 4
+                dt = _timed_train_steps(
+                    loss_fn, params, optax.adamw(1e-4), (ids,),
+                    n_steps=n_steps,
+                )
+                per_seq[seq] = {
+                    "tokens_per_sec": round(n_steps * batch * seq / dt, 1),
+                    "batch": batch,
+                }
+            except Exception as exc:  # OOM and friends: record, continue
+                per_seq[seq] = {
+                    "error": f"{type(exc).__name__}: {str(exc)[:120]}"
+                }
+            # Free before the next config.
+            params = None
+            import gc
+
+            gc.collect()
+        results[impl] = per_seq
+    return {
+        "tokens_per_sec_by_impl": results,
+        "unit": "tokens/s",
+        "note": (
+            "single-chip; ring attention additionally scales seq over "
+            "the sp mesh axis (tests/test_attention.py ring-vs-dense "
+            "parity; dryrun_multichip exercises the sp sharding)"
+        ),
+    }
+
+
 def bench_etl_window():
     """Window-function throughput (the reference's DLRM preprocessing
     idiom: row_number().over(partitionBy(...).orderBy(desc(...))) —
@@ -678,6 +982,9 @@ def main():
         ("titanic_classifier", bench_titanic),
         ("bert_glue", bench_bert),
         ("dlrm_criteo", bench_dlrm),
+        ("dlrm_embedding_study", bench_dlrm_embedding_study),
+        ("dlrm_criteo_scale", bench_dlrm_criteo_scale),
+        ("longcontext_seq_scaling", bench_longcontext),
     ]:
         try:
             configs[name] = fn()

@@ -37,10 +37,34 @@ __all__ = ["GBTEstimator"]
 
 
 def _quantile_bins(col: np.ndarray, max_bins: int) -> np.ndarray:
-    """Bin edges (len <= max_bins-1) from quantiles of a column."""
+    """Bin edges (len <= max_bins-1) from quantiles of a column.
+
+    NaN values are excluded from the quantiles (a single NaN would
+    otherwise make every edge NaN and silently drop the feature); at
+    binning time NaN rows sort into the LAST bin (missing-value routing:
+    deterministic "missing goes right", searchsorted's NaN behavior)."""
     qs = np.linspace(0, 1, max_bins + 1)[1:-1]
-    edges = np.unique(np.quantile(col, qs))
-    return edges.astype(np.float32)
+    with np.errstate(all="ignore"):
+        edges = np.unique(np.nanquantile(col, qs))
+    return edges[~np.isnan(edges)].astype(np.float32)
+
+
+def _route_tree(binned, feat, bins, depth: int):
+    """Leaf node index of each row under one fitted flat tree. The ONE
+    descent routine — training/eval routing and predict's scan body both
+    call it, so split semantics (<= threshold goes left, nodes without a
+    split hold their rows) can never desynchronize."""
+    node = jnp.zeros((binned.shape[0],), dtype=jnp.int32)
+    for _ in range(depth):
+        nf = feat[node]
+        nb = bins[node]
+        has_split = nf >= 0
+        row_bin = jnp.take_along_axis(
+            binned, jnp.maximum(nf, 0)[:, None], axis=1
+        )[:, 0]
+        child = jnp.where(row_bin <= nb, 2 * node + 1, 2 * node + 2)
+        node = jnp.where(has_split, child, node)
+    return node
 
 
 @partial(jax.jit, static_argnames=("n_nodes", "n_feat", "n_bins"))
@@ -80,7 +104,10 @@ def _best_splits(gsum, hsum, lam, n_nodes: int):
     gr = gt - gl
     hr = ht - hl
     def score(g, h):
-        return (g * g) / (h + lam)
+        # Epsilon floor: with reg_lambda=0 an empty partition is 0/0 →
+        # NaN, and argmax treats NaN as max — silently suppressing every
+        # real split.
+        return (g * g) / jnp.maximum(h + lam, 1e-12)
     # Gain of splitting after bin b (last bin = no split → -inf).
     gain = score(gl, hl) + score(gr, hr) - score(gt, ht)
     gain = gain.at[:, :, -1].set(-jnp.inf)
@@ -246,7 +273,7 @@ class GBTEstimator:
 
         depth = self.max_depth
         n_nodes_total = 2 ** (depth + 1) - 1
-        T = int(n_rounds) if n_rounds else self.n_trees
+        T = int(n_rounds) if n_rounds is not None else self.n_trees
         feat_arr = np.full((T, n_nodes_total), -1, dtype=np.int32)
         bin_arr = np.zeros((T, n_nodes_total), dtype=np.int32)
         leaf_arr = np.zeros((T, n_nodes_total), dtype=np.float32)
@@ -332,19 +359,9 @@ class GBTEstimator:
 
     def _route(self, binned, feat_t: np.ndarray, bin_t: np.ndarray):
         """Leaf node index for each row under ONE fitted tree."""
-        f = jnp.asarray(feat_t)
-        b = jnp.asarray(bin_t)
-        node = jnp.zeros((binned.shape[0],), dtype=jnp.int32)
-        for _ in range(self.max_depth):
-            nf = f[node]
-            nb = b[node]
-            has_split = nf >= 0
-            row_bin = jnp.take_along_axis(
-                binned, jnp.maximum(nf, 0)[:, None], axis=1
-            )[:, 0]
-            child = jnp.where(row_bin <= nb, 2 * node + 1, 2 * node + 2)
-            node = jnp.where(has_split, child, node)
-        return node
+        return _route_tree(
+            binned, jnp.asarray(feat_t), jnp.asarray(bin_t), self.max_depth
+        )
 
     # -- inference ------------------------------------------------------
     def _raw_predict(self, X: np.ndarray) -> np.ndarray:
@@ -360,18 +377,7 @@ class GBTEstimator:
 
             def one_tree(carry, tree):
                 f, b, v = tree
-                node = jnp.zeros((n,), dtype=jnp.int32)
-                for _ in range(depth):
-                    nf = f[node]
-                    nb = b[node]
-                    has_split = nf >= 0
-                    row_bin = jnp.take_along_axis(
-                        binned, jnp.maximum(nf, 0)[:, None], axis=1
-                    )[:, 0]
-                    child = jnp.where(
-                        row_bin <= nb, 2 * node + 1, 2 * node + 2
-                    )
-                    node = jnp.where(has_split, child, node)
+                node = _route_tree(binned, f, b, depth)
                 return carry + v[node], None
 
             out, _ = jax.lax.scan(

@@ -140,3 +140,53 @@ def test_gbt_data_parallel_matches_single_device():
 def test_gbt_save_unfitted_raises():
     with pytest.raises(ValueError, match="unfitted"):
         GBTEstimator().save("/tmp/never")
+
+
+def test_gbt_reg_lambda_zero_still_splits():
+    """lam=0 must not NaN-poison split gains (review r3b #1)."""
+    pdf = _reg_frame(n=1200, seed=11)
+    est = GBTEstimator(
+        n_trees=8, max_depth=3, reg_lambda=0.0,
+        feature_columns=["a", "b", "c"], label_column="y",
+    )
+    hist = est.fit_on_df(rdf.from_pandas(pdf, num_partitions=2))
+    assert hist[-1]["train_loss"] < hist[0]["train_loss"] * 0.9
+    assert (est._trees["feature"] >= 0).any()
+
+
+def test_gbt_nan_feature_not_silently_dropped():
+    """NaN values bin into the last bin; the feature still splits
+    (review r3b #2)."""
+    rng = np.random.RandomState(2)
+    n = 2000
+    a = rng.randn(n)
+    a[rng.rand(n) < 0.05] = np.nan  # 5% missing
+    pdf = pd.DataFrame({"a": a, "b": rng.randn(n)})
+    pdf["y"] = np.where(np.nan_to_num(pdf.a, nan=0.0) > 0, 5.0, -5.0)
+    est = GBTEstimator(
+        n_trees=15, max_depth=3,
+        feature_columns=["a", "b"], label_column="y",
+    )
+    est.fit_on_df(rdf.from_pandas(pdf, num_partitions=2))
+    # Edges for the NaN-bearing column are finite and usable...
+    assert len(est._edges[0]) > 1
+    assert np.isfinite(est._edges[0]).all()
+    # ...and the model actually split on it (it carries all the signal).
+    assert (est._trees["feature"] == 0).any()
+    pred = est.predict(pdf[["a", "b"]].to_numpy())
+    assert np.mean((pred > 0) == (pdf.y.to_numpy() > 0)) > 0.9
+
+
+def test_gbt_num_epochs_zero_trains_nothing():
+    pdf = _reg_frame(n=500, seed=13)
+    est = GBTEstimator(
+        n_trees=5, feature_columns=["a", "b", "c"], label_column="y",
+    )
+    hist = est.fit(
+        MLDataset.from_df(rdf.from_pandas(pdf, num_partitions=1), num_shards=1),
+        num_epochs=0,
+    )
+    assert hist == []
+    # Prediction falls back to the base score for every row.
+    pred = est.predict(pdf[["a", "b", "c"]].to_numpy())
+    assert np.allclose(pred, est._base_score)
