@@ -216,13 +216,9 @@ class DataFrame:
             out._exchange_keys = tuple(keys)
             return out
 
-        def splitter(t: pa.Table) -> List[pa.Table]:
-            if t.num_rows == 0:
-                return [t] * n_out
-            bucket = _hash_bucket(t, keys, n_out)
-            return _split_by_bucket(t, bucket, n_out)
-
-        parts = df._executor.exchange(df._parts, splitter, n_out)
+        parts = df._executor.exchange(
+            df._parts, _bucket_splitter(list(keys), n_out), n_out
+        )
         out = DataFrame(parts, df._executor)
         out._exchange_keys = tuple(keys)
         return out
@@ -461,6 +457,21 @@ class DataFrame:
             raise ValueError(f"unsupported join type {how!r}")
 
         from raydp_tpu.dataframe.executor import ClusterExecutor
+
+        # Right/full outer joins MUST shuffle: a per-partition broadcast
+        # join emits each unmatched right row once per left partition
+        # (every partition independently null-pads it) — wrong results,
+        # not just wrong perf. Large build sides also shuffle
+        # (broadcasting would materialize and re-ship them whole —
+        # Spark's autoBroadcastJoinThreshold decision).
+        right_bytes = sum(
+            right._executor.part_nbytes(p) for p in right._parts
+        )
+        if (
+            join_type in ("right outer", "full outer")
+            or right_bytes > _BROADCAST_JOIN_BYTES
+        ):
+            return _shuffle_join(left, right, keys, join_type)
 
         right_table = _concat(
             [right._executor.materialize(p) for p in right._parts]
@@ -794,11 +805,7 @@ class GroupedData:
         def partial_fn(t: pa.Table) -> pa.Table:
             return _local_agg(t, keys, partial_specs)
 
-        def splitter(t: pa.Table) -> List[pa.Table]:
-            if t.num_rows == 0:
-                return [t] * n_out
-            bucket = _hash_bucket(t, keys, n_out)
-            return _split_by_bucket(t, bucket, n_out)
+        splitter = _bucket_splitter(list(keys), n_out)
 
         def combine(t: pa.Table) -> pa.Table:
             if t.num_rows == 0:
@@ -1037,6 +1044,29 @@ def _split_by_bucket(t: pa.Table, bucket: np.ndarray, n: int) -> List[pa.Table]:
     return [taken.slice(offsets[i], counts[i]) for i in range(n)]
 
 
+def _bucket_splitter(keys: List[str], n_out: int, cast_to=None):
+    """THE hash-exchange splitter (groupBy merge phase, key co-location,
+    both sides of a shuffle join): rows route to ``hash(keys) % n_out``.
+    ``cast_to`` ({key: pa type}) aligns key dtypes first — both sides of
+    a join must bucket identical key VALUES identically, and
+    _hash_bucket's algorithm choice depends on the schema."""
+
+    def splitter(t: pa.Table) -> List[pa.Table]:
+        if cast_to:
+            for k, typ in cast_to.items():
+                if t.schema.field(k).type != typ:
+                    t = t.set_column(
+                        t.column_names.index(k), k,
+                        pc.cast(t.column(k), typ),
+                    )
+        if t.num_rows == 0:
+            return [t] * n_out
+        bucket = _hash_bucket(t, keys, n_out)
+        return _split_by_bucket(t, bucket, n_out)
+
+    return splitter
+
+
 def _partial_name(col_name: str, op: str) -> str:
     return f"__{op}__{col_name}"
 
@@ -1070,6 +1100,42 @@ _COMBINE_COALESCE_BYTES = _env_bytes(
 _EXCHANGE_COALESCE_BYTES = _env_bytes(
     "RAYDP_TPU_EXCHANGE_COALESCE_BYTES", 32 << 20
 )
+_BROADCAST_JOIN_BYTES = _env_bytes(
+    "RAYDP_TPU_BROADCAST_JOIN_BYTES", 64 << 20
+)
+
+
+def _shuffle_join(
+    left: "DataFrame", right: "DataFrame", keys: List[str], join_type: str
+) -> "DataFrame":
+    """Shuffle hash join: both sides exchange on the join keys with the
+    SAME bucketing, then bucket i joins bucket i (Spark's
+    SortMergeJoin/ShuffledHashJoin role for large×large joins; the
+    broadcast join handles the dimension-table case)."""
+    n_out = max(
+        1,
+        min(
+            max(len(left._parts), len(right._parts)),
+            left._executor.default_fanout(),
+        ),
+    )
+    left_schema = {k: left.schema.field(k).type for k in keys}
+    lparts = left._executor.exchange(
+        left._parts, _bucket_splitter(keys, n_out), n_out
+    )
+    rparts = right._executor.exchange(
+        right._parts,
+        _bucket_splitter(keys, n_out, cast_to=left_schema),
+        n_out,
+    )
+
+    def join_pair(lt: pa.Table, rt: pa.Table) -> pa.Table:
+        return _join_aligned(lt, rt, keys, join_type)
+
+    parts = left._executor.map_pairs(lparts, rparts, join_pair)
+    left._executor.discard(lparts)
+    left._executor.discard(rparts)
+    return DataFrame(parts, left._executor)
 
 
 def _direct_agg_supported(specs: List[Tuple[str, str]]) -> bool:

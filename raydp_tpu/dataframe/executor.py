@@ -38,6 +38,16 @@ class Executor:
         index (for partition-indexed ops like monotonically_increasing_id)."""
         raise NotImplementedError
 
+    def map_pairs(
+        self,
+        parts_a: List[Any],
+        parts_b: List[Any],
+        fn: Callable[[pa.Table, pa.Table], pa.Table],
+    ) -> List[Any]:
+        """Zip two equally-partitioned lists through a binary stage
+        (bucket i of a shuffle join meets bucket i)."""
+        raise NotImplementedError
+
     def exchange(
         self,
         parts: List[Any],
@@ -118,6 +128,9 @@ class LocalExecutor(Executor):
 
     def map_partitions_indexed(self, parts, fn):
         return list(self._pool.map(fn, parts, range(len(parts))))
+
+    def map_pairs(self, parts_a, parts_b, fn):
+        return list(self._pool.map(fn, parts_a, parts_b))
 
     def exchange(self, parts, splitter, n_out, combine=None):
         chunked = list(self._pool.map(splitter, parts))
@@ -243,6 +256,20 @@ class ClusterExecutor(Executor):
         return self.cluster.submit_async(
             task, list(parts), worker_id=worker_id
         ).result()
+
+    def map_pairs(self, parts_a, parts_b, fn):
+        def task(ctx, ra, rb):
+            ta = ctx.get_table(ra)
+            tb = ctx.get_table(rb)
+            return ctx.put_table(fn(ta, tb), holder=True)
+
+        futures = [
+            self.cluster.submit_async(
+                task, ra, rb, worker_id=self._worker_for(i, ra)
+            )
+            for i, (ra, rb) in enumerate(zip(parts_a, parts_b))
+        ]
+        return [f.result() for f in futures]
 
     def exchange(self, parts, splitter, n_out, combine=None):
         def split_task(ctx, ref):

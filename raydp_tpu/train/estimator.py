@@ -764,15 +764,20 @@ class JAXEstimator:
         # and defeat the loader's prefetch, just like in fit()).
         totals: Dict[str, Any] = {}
         weight_total = 0.0
-        for loader in loaders:
-            for x, y in loader:
-                w = float(len(x))
-                xd, yd = self._shard_batch(x, y)
-                out = self._eval_step(self._state, xd, yd)
-                for k, v in out.items():
-                    vw = v * w
-                    totals[k] = vw if k not in totals else totals[k] + vw
-                weight_total += w
+
+        def host_batches():
+            for loader in loaders:
+                yield from loader
+
+        # Same double-buffered sharded infeed as fit(): batch N+1's H2D
+        # overlaps batch N's eval step.
+        for xd, yd, blen in self._sharded_prefetch(host_batches()):
+            w = float(blen)
+            out = self._eval_step(self._state, xd, yd)
+            for k, v in out.items():
+                vw = v * w
+                totals[k] = vw if k not in totals else totals[k] + vw
+            weight_total += w
         return {
             f"{prefix}{k}": float(v) / max(1e-9, weight_total)
             for k, v in totals.items()

@@ -582,3 +582,68 @@ def test_agg_adaptive_tiers_parity(monkeypatch, tier):
     assert out["count(v)"].tolist() == g["v"].count().tolist()
     assert out["count"].tolist() == g.size().tolist()
     assert np.allclose(out["max(w)"], g["w"].max())
+
+
+@pytest.mark.parametrize("how,pd_how", [
+    ("inner", "inner"), ("left", "left"), ("outer", "outer"),
+])
+def test_shuffle_join_parity(monkeypatch, how, pd_how):
+    """Large-right joins take the shuffle hash join; results must match
+    pandas merge exactly (broadcast path covered by test_join)."""
+    import raydp_tpu.dataframe.dataframe as dfmod
+
+    monkeypatch.setattr(dfmod, "_BROADCAST_JOIN_BYTES", 0)  # force shuffle
+    rng = np.random.RandomState(4)
+    lpdf = pd.DataFrame(
+        {"k": rng.randint(0, 200, 3000), "lv": rng.randn(3000)}
+    )
+    rpdf = pd.DataFrame(
+        {
+            # int32 keys on the right: bucketing must still agree.
+            "k": rng.randint(0, 250, 2500).astype(np.int32),
+            "rv": rng.randn(2500),
+        }
+    )
+    out = (
+        rdf.from_pandas(lpdf, num_partitions=4)
+        .join(rdf.from_pandas(rpdf, num_partitions=3), on="k", how=how)
+        .to_pandas()
+        .sort_values(["k", "lv", "rv"], na_position="last")
+        .reset_index(drop=True)
+    )
+    exp = (
+        lpdf.merge(rpdf.assign(k=rpdf.k.astype(np.int64)), on="k", how=pd_how)
+        .sort_values(["k", "lv", "rv"], na_position="last")
+        .reset_index(drop=True)
+    )
+    assert len(out) == len(exp)
+    assert out["k"].tolist() == exp["k"].tolist()
+    assert np.allclose(
+        out["lv"].fillna(-9e9), exp["lv"].fillna(-9e9)
+    )
+    assert np.allclose(
+        out["rv"].fillna(-9e9), exp["rv"].fillna(-9e9)
+    )
+
+
+def test_broadcast_outer_join_routes_to_shuffle():
+    """Regression (review r3c): a per-partition broadcast right/full
+    outer join duplicated unmatched right rows once per left partition.
+    These join types must shuffle regardless of right-side size."""
+    lpdf = pd.DataFrame({"k": [1, 2, 3, 4], "lv": [10, 20, 30, 40]})
+    rpdf = pd.DataFrame({"k": [2, 99], "rv": [200, 990]})
+    left = rdf.from_pandas(lpdf, num_partitions=2)
+    right = rdf.from_pandas(rpdf, num_partitions=1)
+    out = (
+        left.join(right, on="k", how="outer")
+        .to_pandas()
+        .sort_values("k")
+        .reset_index(drop=True)
+    )
+    exp = lpdf.merge(rpdf, on="k", how="outer")
+    assert len(out) == len(exp) == 5
+    assert out[out.k == 99].rv.tolist() == [990]
+
+    routed = left.join(right, on="k", how="right").to_pandas()
+    assert len(routed) == 2
+    assert sorted(routed.k.tolist()) == [2, 99]
