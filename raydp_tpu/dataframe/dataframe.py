@@ -1119,7 +1119,8 @@ def _shuffle_join(
             left._executor.default_fanout(),
         ),
     )
-    left_schema = {k: left.schema.field(k).type for k in keys}
+    sch = left.schema  # one _peek: schema access materializes a probe
+    left_schema = {k: sch.field(k).type for k in keys}
     lparts = left._executor.exchange(
         left._parts, _bucket_splitter(keys, n_out), n_out
     )

@@ -27,6 +27,28 @@ def _distribute(tables: List[pa.Table], executor: Optional[Executor] = None) -> 
     return DataFrame(ex.put_many(tables), ex)
 
 
+def _scan_distributed(split_specs, reader) -> Optional[DataFrame]:
+    """Executor-side file scan: under cluster execution each WORKER reads
+    its own split from shared storage (GCS/NFS mount — every node sees
+    the same paths, the TPU-pod deployment shape) and stores the table
+    node-locally; only the split spec travels in the task. The
+    reference's counterpart is Spark executors reading their own input
+    splits. Returns None when there is no cluster (driver reads then)."""
+    from raydp_tpu.dataframe.executor import ClusterExecutor
+
+    ex = _executor()
+    if not isinstance(ex, ClusterExecutor):
+        return None
+
+    def scan_task(ctx, spec):
+        return ctx.put_table(reader(spec), holder=True)
+
+    futures = [
+        ex.cluster.submit_async(scan_task, spec) for spec in split_specs
+    ]
+    return DataFrame([f.result() for f in futures], ex)
+
+
 def from_arrow(table: pa.Table, num_partitions: int = 1) -> DataFrame:
     if num_partitions <= 1:
         return _distribute([table])
@@ -90,16 +112,32 @@ def read_csv(
     """Read CSV file(s) into a partitioned DataFrame. ``path`` may be a
     file, a glob, or a directory."""
     files = _expand(path, (".csv",))
-    convert = None
-    if schema is not None:
-        convert = pa_csv.ConvertOptions(column_types=schema)
-    elif timestamp_columns:
-        convert = pa_csv.ConvertOptions(
-            column_types={c: pa.timestamp("us") for c in timestamp_columns}
-        )
-    tables = [pa_csv.read_csv(f, convert_options=convert) for f in files]
-    df = _distribute(tables)
-    if num_partitions is not None and num_partitions != len(tables):
+    schema_types = (
+        {name: schema.field(name).type for name in schema.names}
+        if schema is not None
+        else None
+    )
+    ts_cols = list(timestamp_columns or [])
+
+    def _read_csv_split(path_: str) -> pa.Table:
+        # The ONE place CSV convert options are built — the local
+        # fallback and the worker-side scan must never diverge.
+        import pyarrow as _pa
+        import pyarrow.csv as _pa_csv
+
+        conv = None
+        if schema_types is not None:
+            conv = _pa_csv.ConvertOptions(column_types=schema_types)
+        elif ts_cols:
+            conv = _pa_csv.ConvertOptions(
+                column_types={c: _pa.timestamp("us") for c in ts_cols}
+            )
+        return _pa_csv.read_csv(path_, convert_options=conv)
+
+    df = _scan_distributed(files, _read_csv_split)
+    if df is None:
+        df = _distribute([_read_csv_split(f) for f in files])
+    if num_partitions is not None and num_partitions != len(files):
         df = df.repartition(num_partitions)
     return df
 
@@ -111,16 +149,41 @@ def read_parquet(
 ) -> DataFrame:
     """Read parquet file(s); one partition per row group when splitting."""
     files = _expand(path, (".parquet", ".pq"))
-    tables: List[pa.Table] = []
+    split_rg = num_partitions is not None and len(files) < num_partitions
+    # Split specs from footer METADATA only (cheap driver-side open).
+    specs: List[tuple] = []
     for f in files:
-        pf = pq.ParquetFile(f)
-        if num_partitions is not None and len(files) < num_partitions:
-            for rg in builtins.range(pf.num_row_groups):
-                tables.append(pf.read_row_group(rg, columns=columns))
+        if split_rg:
+            n_rg = pq.ParquetFile(f).metadata.num_row_groups
+            specs.extend((f, rg, columns) for rg in builtins.range(n_rg))
         else:
-            tables.append(pf.read(columns=columns))
-    df = _distribute(tables)
-    if num_partitions is not None and len(tables) != num_partitions:
+            specs.append((f, None, columns))
+
+    def _read_parquet_split(spec) -> pa.Table:
+        import pyarrow.parquet as _pq
+
+        f_, rg_, cols_ = spec
+        pf = _pq.ParquetFile(f_)
+        if rg_ is None:
+            return pf.read(columns=cols_)
+        return pf.read_row_group(rg_, columns=cols_)
+
+    df = _scan_distributed(specs, _read_parquet_split)
+    if df is None:
+        # Local fallback: one ParquetFile handle per FILE (a handle per
+        # row-group spec would re-parse the footer per row group).
+        tables: List[pa.Table] = []
+        for f in files:
+            pf = pq.ParquetFile(f)
+            if split_rg:
+                tables.extend(
+                    pf.read_row_group(rg, columns=columns)
+                    for rg in builtins.range(pf.metadata.num_row_groups)
+                )
+            else:
+                tables.append(pf.read(columns=columns))
+        df = _distribute(tables)
+    if num_partitions is not None and len(specs) != num_partitions:
         df = df.repartition(num_partitions)
     return df
 

@@ -3,6 +3,7 @@ processes, partitions live in the shm object store (parity with reference
 Spark-executor execution, test_spark_cluster.py:70-98 round-trip)."""
 import numpy as np
 import pandas as pd
+import pyarrow as pa
 import pytest
 
 import raydp_tpu
@@ -76,3 +77,35 @@ def test_to_object_refs_with_ownership(session):
     assert all(r.owner == "__holder__" for r in (store.get_ref(x.object_id) for x in refs))
     total = sum(store.get_arrow_table(r).num_rows for r in refs)
     assert total == 100
+
+
+def test_distributed_file_scan(tmp_path, session):
+    """Under cluster execution, read_parquet/read_csv ship split specs to
+    WORKERS (executor-side scan, Spark's input-split model) — partitions
+    come back as ObjectRefs, one per row group / file."""
+    import pyarrow.parquet as pq
+
+    from raydp_tpu.store.object_store import ObjectRef
+
+    pdf = pd.DataFrame(
+        {"a": np.arange(8_000), "b": np.random.randn(8_000)}
+    )
+    for i in range(2):
+        pq.write_table(
+            pa.Table.from_pandas(
+                pdf.iloc[i * 4000:(i + 1) * 4000], preserve_index=False
+            ),
+            str(tmp_path / f"p{i}.parquet"),
+            row_group_size=2000,
+        )
+    pdf.to_csv(str(tmp_path / "all.csv"), index=False)
+
+    df = rdf.read_parquet(str(tmp_path / "*.parquet"), num_partitions=4)
+    assert all(isinstance(p, ObjectRef) for p in df._parts)
+    assert df.num_partitions == 4
+    out = df.to_pandas().sort_values("a").reset_index(drop=True)
+    assert out["a"].tolist() == pdf["a"].tolist()
+
+    dfc = rdf.read_csv(str(tmp_path / "all.csv"))
+    assert all(isinstance(p, ObjectRef) for p in dfc._parts)
+    assert dfc.count() == 8_000
