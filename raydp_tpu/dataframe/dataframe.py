@@ -403,7 +403,9 @@ class DataFrame:
 
     def union(self, other: "DataFrame") -> "DataFrame":
         a, b = self._flush(), other._flush()
-        return DataFrame(a._parts + b._parts, a._executor)
+        return DataFrame(
+            a._parts + _coerce_parts(b, a._executor), a._executor
+        )
 
     # -- wide ops -------------------------------------------------------
     def repartition(self, n: int) -> "DataFrame":
@@ -1044,6 +1046,17 @@ def _split_by_bucket(t: pa.Table, bucket: np.ndarray, n: int) -> List[pa.Table]:
     return [taken.slice(offsets[i], counts[i]) for i in range(n)]
 
 
+def _coerce_parts(df: "DataFrame", executor: Executor) -> List[Any]:
+    """``df``'s partitions usable by ``executor`` — binary ops (union,
+    shuffle join) may mix a local frame with a cluster one; materialize
+    and re-put when the executors differ."""
+    if df._executor is executor or type(df._executor) is type(executor):
+        return list(df._parts)
+    return [
+        executor.put(df._executor.materialize(p)) for p in df._parts
+    ]
+
+
 def _bucket_splitter(keys: List[str], n_out: int, cast_to=None):
     """THE hash-exchange splitter (groupBy merge phase, key co-location,
     both sides of a shuffle join): rows route to ``hash(keys) % n_out``.
@@ -1124,8 +1137,8 @@ def _shuffle_join(
     lparts = left._executor.exchange(
         left._parts, _bucket_splitter(keys, n_out), n_out
     )
-    rparts = right._executor.exchange(
-        right._parts,
+    rparts = left._executor.exchange(
+        _coerce_parts(right, left._executor),
         _bucket_splitter(keys, n_out, cast_to=left_schema),
         n_out,
     )

@@ -109,3 +109,24 @@ def test_distributed_file_scan(tmp_path, session):
     dfc = rdf.read_csv(str(tmp_path / "all.csv"))
     assert all(isinstance(p, ObjectRef) for p in dfc._parts)
     assert dfc.count() == 8_000
+
+
+def test_union_mixed_executors(session):
+    """Union (and binary ops generally) must coerce a local frame's
+    partitions into the cluster executor instead of mixing raw tables
+    with ObjectRefs."""
+    from raydp_tpu.dataframe.executor import LocalExecutor
+    from raydp_tpu.store.object_store import ObjectRef
+
+    cluster_df = rdf.from_pandas(
+        pd.DataFrame({"x": [1, 2, 3]}), num_partitions=2
+    )
+    assert all(isinstance(p, ObjectRef) for p in cluster_df._flush()._parts)
+    local_df = rdf.DataFrame(
+        [pa.table({"x": [4, 5]})], LocalExecutor()
+    )
+    out = cluster_df.union(local_df)
+    assert sorted(out.to_pandas()["x"].tolist()) == [1, 2, 3, 4, 5]
+    # and the reverse direction: cluster parts materialize into local
+    out2 = local_df.union(cluster_df)
+    assert sorted(out2.to_pandas()["x"].tolist()) == [1, 2, 3, 4, 5]
