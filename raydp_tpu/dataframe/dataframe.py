@@ -232,14 +232,37 @@ class DataFrame:
             return df
         exchanged = df._exchange_by_keys(list(keys))
 
+        all_cols = list(keys)
+
         def dedupe(t: pa.Table) -> pa.Table:
             if t.num_rows == 0:
                 return t
-            pdf = t.to_pandas().drop_duplicates(
-                subset=subset if subset else None
-            )
-            return pa.Table.from_pandas(pdf, preserve_index=False,
-                                        schema=t.schema)
+            try:
+                if subset:
+                    # Keep the FIRST row per key (Spark dropDuplicates).
+                    others = [
+                        c for c in t.column_names if c not in subset
+                    ]
+                    agged = t.group_by(
+                        list(subset), use_threads=False
+                    ).aggregate([(c, "first") for c in others])
+                    agged = agged.rename_columns(list(subset) + others)
+                    return agged.select(t.column_names)
+                # Full-row distinct: group by every column, no aggregates
+                # — one vectorized arrow hash pass.
+                return t.group_by(
+                    all_cols, use_threads=False
+                ).aggregate([])
+            except (pa.ArrowInvalid, pa.ArrowNotImplementedError, TypeError):
+                # Non-groupable dtypes (nested lists...): pandas fallback.
+                import pandas as pd  # noqa: F401
+
+                pdf = t.to_pandas().drop_duplicates(
+                    subset=subset if subset else None
+                )
+                return pa.Table.from_pandas(
+                    pdf, preserve_index=False, schema=t.schema
+                )
 
         return exchanged._with(dedupe)._flush()
 
@@ -509,10 +532,21 @@ class DataFrame:
             for c, asc in zip(columns, ascending)
         ]
         n_out = len(df._parts)
-        if n_out <= 1:
+        # Small data: ONE multithreaded arrow sort in one task beats the
+        # sample-quantile range exchange (same adaptive decision as the
+        # agg/window coalesce).
+        small = n_out > 1 and sum(
+            df._executor.part_nbytes(p) for p in df._parts
+        ) <= _EXCHANGE_COALESCE_BYTES
+        if n_out <= 1 or small:
             def sort_one(t: pa.Table) -> pa.Table:
                 return t.sort_by(sort_keys)
 
+            if small:
+                part = df._executor.run_coalesced(
+                    df._parts, lambda ts: sort_one(_concat(ts))
+                )
+                return DataFrame([part], df._executor)
             return DataFrame(
                 df._executor.map_partitions(df._parts, sort_one), df._executor
             )
