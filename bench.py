@@ -621,7 +621,7 @@ def bench_etl_groupby():
     import raydp_tpu
     import raydp_tpu.dataframe as rdf
 
-    n_rows = 500_000 if _CPU_FALLBACK else 2_000_000
+    n_rows = 1_000_000 if _CPU_FALLBACK else 2_000_000
     rng = np.random.RandomState(9)
     pdf = pd.DataFrame(
         {
