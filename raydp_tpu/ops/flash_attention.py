@@ -6,6 +6,12 @@ q@k^T and p@v per tile; HBM traffic is O(S·D) instead of O(S²). Grid is
 grid dimension — each step gets one K/V tile via BlockSpec DMA while the
 running (max, sum, acc) live in scratch across kv steps.
 
+The BACKWARD pass is blockwise too (two kernels: dq over kv tiles, and
+dk/dv over q tiles, both re-computing p from the forward's saved row
+logsumexp) — so training never materializes the S×S score matrix either,
+which is the whole long-context point (a dense-recompute backward would
+put an O(S²) cliff right back at seq 8k–16k).
+
 Falls back to interpret mode off-TPU (pallas guide: Debugging) so tests
 exercise identical code paths on the CPU mesh.
 """
@@ -22,8 +28,35 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
-                  block_kv: int, causal: bool, scale: float, q_block: int):
+def _tile_live(qi, ki, causal: bool, q_block: int, block_kv: int):
+    """Whether tile (qi, ki) has any unmasked entries (causal skip)."""
+    if not causal:
+        return True
+    return (qi + 1) * q_block - 1 >= ki * block_kv
+
+
+def _masked_scores(q_ref, k_ref, qi, ki, *, scale: float, causal: bool,
+                   q_block: int, block_kv: int):
+    """Shared tile math for ALL kernels (forward, dq, dkv): load raw
+    q/k tiles and compute the scaled, causally-masked score tile — one
+    definition, so forward and backward masking can never diverge."""
+    q = q_ref[0, 0].astype(jnp.float32)
+    k = k_ref[0, 0].astype(jnp.float32)
+    s = (q * scale) @ k.T
+    if causal:
+        q_pos = qi * q_block + jax.lax.broadcasted_iota(
+            jnp.int32, (q_block, block_kv), 0
+        )
+        k_pos = ki * block_kv + jax.lax.broadcasted_iota(
+            jnp.int32, (q_block, block_kv), 1
+        )
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    return q, k, s
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
+                  acc_ref, *, block_kv: int, causal: bool, scale: float,
+                  q_block: int):
     """Grid (b, h, q_blocks, kv_blocks); kv is the innermost sequential
     dimension, so only one [block_kv, d] K/V tile is VMEM-resident at a
     time and the (m, l, acc) scratch carries across kv steps."""
@@ -38,24 +71,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
     # Causal: blocks strictly above the diagonal contribute nothing.
-    q_end = (qi + 1) * q_block - 1  # last query position in this block
-    k_start = ki * block_kv
-    live = (q_end >= k_start) if causal else True
-
-    @pl.when(live)
+    @pl.when(_tile_live(qi, ki, causal, q_block, block_kv))
     def _attend():
-        q = q_ref[0, 0].astype(jnp.float32) * scale  # [q_block, d]
-        k = k_ref[0, 0].astype(jnp.float32)          # [block_kv, d]
+        _, _, s = _masked_scores(
+            q_ref, k_ref, qi, ki, scale=scale, causal=causal,
+            q_block=q_block, block_kv=block_kv,
+        )
         v = v_ref[0, 0].astype(jnp.float32)
-        s = q @ k.T
-        if causal:
-            q_pos = qi * q_block + jax.lax.broadcasted_iota(
-                jnp.int32, (q_block, block_kv), 0
-            )
-            k_pos = k_start + jax.lax.broadcasted_iota(
-                jnp.int32, (q_block, block_kv), 1
-            )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         m_prev = m_ref[...]
         m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -66,9 +88,80 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
 
     @pl.when(ki == n_kv - 1)
     def _finish():
-        o_ref[0, 0] = (
-            acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)
-        ).astype(o_ref.dtype)
+        l = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0, 0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        # Row logsumexp of the SCALED scores — the backward kernels
+        # rebuild p = exp(s - lse) from it without a second online pass.
+        lse_ref[0, 0] = m_ref[...] + jnp.log(l)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
+                   acc_ref, *, block_kv: int, causal: bool, scale: float,
+                   q_block: int):
+    """dq for one q tile, accumulated over kv tiles (innermost grid dim).
+
+    ds = p ⊙ (g·vᵀ − delta);  dq = scale · ds · k   — all tile-shaped.
+    """
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    n_kv = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(_tile_live(qi, ki, causal, q_block, block_kv))
+    def _accumulate():
+        _, k, s = _masked_scores(
+            q_ref, k_ref, qi, ki, scale=scale, causal=causal,
+            q_block=q_block, block_kv=block_kv,
+        )
+        v = v_ref[0, 0].astype(jnp.float32)
+        g = g_ref[0, 0].astype(jnp.float32)
+        p = jnp.exp(s - lse_ref[0, 0])          # [q_block, block_kv]
+        dp = g @ v.T                             # [q_block, block_kv]
+        ds = p * (dp - delta_ref[0, 0])
+        acc_ref[...] += (ds @ k) * scale
+
+    @pl.when(ki == n_kv - 1)
+    def _finish():
+        dq_ref[0, 0] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, block_kv: int,
+                    causal: bool, scale: float, q_block: int):
+    """dk/dv for one kv tile, accumulated over q tiles (innermost).
+
+    dv = pᵀ · g;  dk = scale · dsᵀ · q.
+    """
+    ki = pl.program_id(2)   # kv tile is the OUTER tile here
+    qi = pl.program_id(3)
+    n_q = pl.num_programs(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    @pl.when(_tile_live(qi, ki, causal, q_block, block_kv))
+    def _accumulate():
+        q, _, s = _masked_scores(
+            q_ref, k_ref, qi, ki, scale=scale, causal=causal,
+            q_block=q_block, block_kv=block_kv,
+        )
+        v = v_ref[0, 0].astype(jnp.float32)
+        g = g_ref[0, 0].astype(jnp.float32)
+        p = jnp.exp(s - lse_ref[0, 0])
+        dv_acc[...] += p.T @ g
+        dp = g @ v.T
+        ds = p * (dp - delta_ref[0, 0])
+        dk_acc[...] += (ds.T @ q) * scale
+
+    @pl.when(qi == n_q - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
 
 
 @functools.partial(
@@ -85,32 +178,97 @@ def flash_attention(
 ) -> jnp.ndarray:
     """Shapes [B, S, H, D] → [B, S, H, D]. S must divide by the blocks.
 
-    Differentiable via custom_vjp: the forward pass is the pallas kernel;
-    the backward pass recomputes attention with stable reference math
-    (dedicated backward kernel is a planned optimization)."""
+    Differentiable via custom_vjp; forward AND backward are blockwise
+    pallas kernels (no S×S materialization anywhere)."""
     return _flash_vjp(q, k, v, causal, block_q, block_kv, interpret)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash_vjp(q, k, v, causal, block_q, block_kv, interpret):
-    return _flash_forward(q, k, v, causal, block_q, block_kv, interpret)
+    out_t, _, _, _, _ = _flash_forward(
+        q, k, v, causal, block_q, block_kv, interpret
+    )
+    return jnp.einsum("bhsd->bshd", out_t)
 
 
 def _flash_fwd_rule(q, k, v, causal, block_q, block_kv, interpret):
-    out = _flash_forward(q, k, v, causal, block_q, block_kv, interpret)
-    return out, (q, k, v)
+    out_t, lse, qt, kt, vt = _flash_forward(
+        q, k, v, causal, block_q, block_kv, interpret
+    )
+    # Residuals stay in the kernels' [B,H,S,D] layout — the backward
+    # would otherwise re-transpose q/k/v/out all over again.
+    return jnp.einsum("bhsd->bshd", out_t), (qt, kt, vt, out_t, lse)
 
 
 def _flash_bwd_rule(causal, block_q, block_kv, interpret, res, g):
-    q, k, v = res
+    qt, kt, vt, out_t, lse = res
+    b, h, s, d = qt.shape
+    block_q = min(block_q, s)
+    block_kv = min(block_kv, s)
+    scale = 1.0 / math.sqrt(d)
 
-    def ref(q, k, v):
-        from raydp_tpu.ops.attention import reference_attention
+    gt = jnp.einsum("bshd->bhsd", g)
+    # delta_i = Σ_d dO_i · O_i — the softmax-jacobian row term.
+    delta = jnp.einsum(
+        "bhsd,bhsd->bhs", gt.astype(jnp.float32), out_t.astype(jnp.float32)
+    )[..., None]
 
-        return reference_attention(q, k, v, causal=causal)
+    q_spec = pl.BlockSpec(
+        (1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
+    )
+    kv_spec = pl.BlockSpec(
+        (1, 1, block_kv, d), lambda bi, hi, qi, ki: (bi, hi, ki, 0)
+    )
+    row_spec = pl.BlockSpec(
+        (1, 1, block_q, 1), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
+    )
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, block_kv=block_kv, causal=causal, scale=scale,
+            q_block=block_q,
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, s, d), qt.dtype),
+        grid=(b, h, s // block_q, s // block_kv),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt, gt, lse, delta)
 
-    _, vjp = jax.vjp(ref, q, k, v)
-    return vjp(g)
+    # dk/dv iterate kv as the outer tile, q innermost.
+    q_spec_t = pl.BlockSpec(
+        (1, 1, block_q, d), lambda bi, hi, ki, qi: (bi, hi, qi, 0)
+    )
+    kv_spec_t = pl.BlockSpec(
+        (1, 1, block_kv, d), lambda bi, hi, ki, qi: (bi, hi, ki, 0)
+    )
+    row_spec_t = pl.BlockSpec(
+        (1, 1, block_q, 1), lambda bi, hi, ki, qi: (bi, hi, qi, 0)
+    )
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, block_kv=block_kv, causal=causal, scale=scale,
+            q_block=block_q,
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((b, h, s, d), kt.dtype),
+            jax.ShapeDtypeStruct((b, h, s, d), vt.dtype),
+        ),
+        grid=(b, h, s // block_kv, s // block_q),
+        in_specs=[
+            q_spec_t, kv_spec_t, kv_spec_t, q_spec_t, row_spec_t,
+            row_spec_t,
+        ],
+        out_specs=(kv_spec_t, kv_spec_t),
+        scratch_shapes=[
+            pltpu.VMEM((block_kv, d), jnp.float32),
+            pltpu.VMEM((block_kv, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, gt, lse, delta)
+
+    to_bshd = lambda x: jnp.einsum("bhsd->bshd", x)  # noqa: E731
+    return to_bshd(dq), to_bshd(dk), to_bshd(dv)
 
 
 _flash_vjp.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -124,7 +282,7 @@ def _flash_forward(
     block_q: int,
     block_kv: int,
     interpret: bool,
-) -> jnp.ndarray:
+):
     b, s, h, d = q.shape
     block_q = min(block_q, s)
     block_kv = min(block_kv, s)
@@ -146,9 +304,12 @@ def _flash_forward(
         scale=scale,
         q_block=block_q,
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, s, 1), jnp.float32),
+        ),
         grid=grid,
         in_specs=[
             pl.BlockSpec(
@@ -161,8 +322,13 @@ def _flash_forward(
                 (1, 1, block_kv, d), lambda bi, hi, qi, ki: (bi, hi, ki, 0)
             ),
         ],
-        out_specs=pl.BlockSpec(
-            (1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
+        out_specs=(
+            pl.BlockSpec(
+                (1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_q, 1), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
+            ),
         ),
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -171,4 +337,4 @@ def _flash_forward(
         ],
         interpret=interpret,
     )(qt, kt, vt)
-    return jnp.einsum("bhsd->bshd", out)
+    return out, lse, qt, kt, vt
