@@ -233,9 +233,11 @@ BERT_BATCH = 32
 
 
 def _bert_sweep(make_cfg, batches=(32, 64, 128), impls=("dense", "flash")):
-    """Raw train-step throughput over (batch, attention impl): the MFU
-    lever the r2 verdict asked to sweep (tunnel-blocked then). Returns
-    (table, best_batch, best_impl)."""
+    """Raw train-step throughput over (batch, attention impl, remat):
+    the MFU levers the r2 verdict asked to sweep (tunnel-blocked then).
+    Remat variants run at the largest batch only — that is where
+    memory-bound configs need the FLOPs-for-HBM trade. Returns
+    (table, best_batch, best_impl_config)."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -245,38 +247,38 @@ def _bert_sweep(make_cfg, batches=(32, 64, 128), impls=("dense", "flash")):
     rs = np.random.RandomState(0)
     table = {}
     best = (None, None, 0.0)
-    for impl in impls:
-        cfg = make_cfg(impl)
+    combos = [(impl, False, b) for impl in impls for b in batches]
+    combos += [(impl, True, max(batches)) for impl in impls]
+    for impl, remat, batch in combos:
+        cfg = make_cfg(impl, remat)
         model = SequenceClassifier(cfg=cfg, num_classes=2)
-        for batch in batches:
-            ids = jnp.asarray(
-                rs.randint(0, cfg.vocab_size, size=(batch, BERT_SEQ))
+        ids = jnp.asarray(
+            rs.randint(0, cfg.vocab_size, size=(batch, BERT_SEQ))
+        )
+        labels = jnp.asarray(rs.randint(0, 2, size=(batch,)))
+
+        def loss_fn(p, ids, labels):
+            logits = model.apply(p, ids)
+            ll = jax.nn.log_softmax(logits.astype(jnp.float32))
+            return -jnp.mean(
+                jnp.take_along_axis(ll, labels[:, None], axis=-1)
             )
-            labels = jnp.asarray(rs.randint(0, 2, size=(batch,)))
 
-            def loss_fn(p, ids, labels):
-                logits = model.apply(p, ids)
-                ll = jax.nn.log_softmax(logits.astype(jnp.float32))
-                return -jnp.mean(
-                    jnp.take_along_axis(ll, labels[:, None], axis=-1)
-                )
-
-            try:
-                params = model.init(jax.random.PRNGKey(0), ids)
-                n_steps = 6
-                dt = _timed_train_steps(
-                    loss_fn, params, optax.adamw(2e-5), (ids, labels),
-                    n_steps=n_steps,
-                )
-                rate = n_steps * batch / dt
-                table[f"{impl}_b{batch}"] = round(rate, 2)
-                if rate > best[2]:
-                    best = (batch, impl, rate)
-            except Exception as exc:
-                table[f"{impl}_b{batch}"] = (
-                    f"{type(exc).__name__}: {str(exc)[:80]}"
-                )
-            params = None
+        tag = f"{impl}{'_remat' if remat else ''}_b{batch}"
+        try:
+            params = model.init(jax.random.PRNGKey(0), ids)
+            n_steps = 6
+            dt = _timed_train_steps(
+                loss_fn, params, optax.adamw(2e-5), (ids, labels),
+                n_steps=n_steps,
+            )
+            rate = n_steps * batch / dt
+            table[tag] = round(rate, 2)
+            if rate > best[2]:
+                best = (batch, (impl, remat), rate)
+        except Exception as exc:
+            table[tag] = f"{type(exc).__name__}: {str(exc)[:80]}"
+        params = None
     return table, best[0], best[1]
 
 
@@ -298,17 +300,20 @@ def bench_bert():
         cfg = bert_base(max_len=BERT_SEQ, dropout_rate=0.1)
         # On the real chip: find the throughput-best (batch, attention)
         # before the estimator run, and use it.
-        sweep, best_batch, best_impl = _bert_sweep(
-            lambda impl: bert_base(
-                max_len=BERT_SEQ, dropout_rate=0.1, attention_impl=impl
+        sweep, best_batch, best_cfg = _bert_sweep(
+            lambda impl, remat: bert_base(
+                max_len=BERT_SEQ, dropout_rate=0.1, attention_impl=impl,
+                remat=remat,
             )
         )
         if best_batch is not None:
             bert_batch = best_batch
+            best_impl, best_remat = best_cfg
             cfg = bert_base(
                 max_len=BERT_SEQ,
                 dropout_rate=0.1,
                 attention_impl=best_impl,
+                remat=best_remat,
             )
     model = SequenceClassifier(cfg=cfg, num_classes=2)
     n_rows = 20 * bert_batch

@@ -61,6 +61,7 @@ class TransformerConfig:
     dropout_rate: float = 0.1
     causal: bool = False
     attention_impl: str = "dense"    # dense | ring | ulysses | flash
+    remat: bool = False              # checkpoint blocks (memory-bound fits)
     dtype: Any = jnp.bfloat16        # compute dtype (MXU-friendly)
     param_dtype: Any = jnp.float32
     mesh: Any = None                 # required for ring/ulysses
@@ -212,8 +213,16 @@ class TransformerEncoder(nn.Module):
         if cfg.dropout_rate > 0:
             x = nn.Dropout(cfg.dropout_rate)(x, deterministic)
         x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+        # remat: recompute block activations in the backward instead of
+        # storing them — the standard FLOPs-for-HBM trade that unlocks
+        # bigger batches/sequences when training is memory-bound.
+        block_cls = (
+            nn.remat(TransformerBlock, static_argnums=(2,))
+            if cfg.remat
+            else TransformerBlock
+        )
         for i in range(cfg.n_layers):
-            x = TransformerBlock(cfg, name=f"block_{i}")(x, deterministic)
+            x = block_cls(cfg, name=f"block_{i}")(x, deterministic)
         return nn.LayerNorm(
             dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="ln_final",
             scale_init=nn.with_logical_partitioning(

@@ -210,3 +210,40 @@ def test_causal_lm_self_supervised_fit(eight_cpu_devices):
     history = est.fit_on_df(pdf)
     assert history[-1]["train_loss"] < history[0]["train_loss"]
     assert history[-1]["train_loss"] < 2.0  # grammar is learnable
+
+
+def test_remat_blocks_match_plain():
+    """cfg.remat=True recomputes activations in the backward; outputs and
+    gradients must be identical to the stored-activation path."""
+    import numpy as np
+
+    from raydp_tpu.models.transformer import (
+        SequenceClassifier,
+        tiny_transformer,
+    )
+
+    ids = jnp.asarray(
+        np.random.RandomState(0).randint(0, 1024, size=(2, 64))
+    )
+    params = None
+    outs, grads = {}, {}
+    for remat in (False, True):
+        cfg = tiny_transformer(max_len=64, remat=remat)
+        model = SequenceClassifier(cfg=cfg, num_classes=2)
+        if params is None:
+            params = model.init(jax.random.PRNGKey(0), ids)
+
+        def loss(p):
+            return model.apply(p, ids).astype(jnp.float32).sum()
+
+        outs[remat] = model.apply(params, ids)
+        grads[remat] = jax.grad(loss)(params)
+    np.testing.assert_allclose(
+        np.asarray(outs[True]), np.asarray(outs[False]), rtol=1e-5
+    )
+    ga = jax.tree_util.tree_leaves(grads[True])
+    gb = jax.tree_util.tree_leaves(grads[False])
+    for a, b in zip(ga, gb):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+        )
