@@ -1075,10 +1075,15 @@ def _assemble() -> dict:
         "value": taxi.get("samples_per_sec"),
         "unit": "samples/s",
         "vs_baseline": taxi.get("vs_baseline"),
-        "device": _STATE["chip_device"] if chip_ok else "cpu",
+        # The top-level device describes the HEADLINE number: if the
+        # chip taxi config errored and the CPU one carries the value,
+        # reporting the chip kind would attribute CPU throughput to it.
+        "device": taxi.get("device", "cpu"),
         "configs": configs,
         "cpu_matrix": _STATE["cpu"],
     }
+    if _STATE["chip_device"]:
+        out["chip_device"] = _STATE["chip_device"]
     if _STATE["chip"]:
         out["chip_matrix"] = _STATE["chip"]
     if _STATE["notes"]:

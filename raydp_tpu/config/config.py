@@ -114,8 +114,12 @@ class TrainConfig:
     seed: int = 0
     log_every_steps: int = 50
     checkpoint_dir: Optional[str] = None
-    max_failures: int = 3  # step-level retry budget (parity with Ray Train's
-    # max_retries; reference: python/raydp/torch/estimator.py:269)
+    # Step-level retry budget (parity with Ray Train's max_retries;
+    # reference: python/raydp/torch/estimator.py:269). None = default
+    # budget with buffer donation kept on; setting a value explicitly
+    # turns donation off (unless donate_state says otherwise) so the
+    # retries are actually effective.
+    max_failures: Optional[int] = None
     save_every_steps: int = 0  # >0: mid-epoch checkpoints w/ data position
 
     def __post_init__(self):

@@ -75,11 +75,15 @@ class DataFrame:
             return table
 
         if self._pending_gather and len(self._parts) > 1:
-
-            def gathered(tables: List[pa.Table]) -> pa.Table:
-                return run(_concat(tables))
-
-            parts = [self._executor.run_coalesced(self._parts, gathered)]
+            # pre_concat: the executor memoizes the gathered table by
+            # partition identity, so a repeated query over the same
+            # stored partitions reuses buffers (and with them the window
+            # engine's sorted-frame cache).
+            parts = [
+                self._executor.run_coalesced(
+                    self._parts, run, pre_concat=True
+                )
+            ]
         else:
             parts = self._executor.map_partitions(self._parts, run)
         out = DataFrame(parts, self._executor)
@@ -544,7 +548,7 @@ class DataFrame:
 
             if small:
                 part = df._executor.run_coalesced(
-                    df._parts, lambda ts: sort_one(_concat(ts))
+                    df._parts, sort_one, pre_concat=True
                 )
                 return DataFrame([part], df._executor)
             return DataFrame(
@@ -822,12 +826,12 @@ class GroupedData:
             keys_ = list(keys)
             specs_ = list(specs)
 
-            def direct(tables: List[pa.Table]) -> pa.Table:
-                from raydp_tpu.dataframe.executor import _concat
+            def direct(table: pa.Table) -> pa.Table:
+                return _direct_agg(table, keys_, specs_)
 
-                return _direct_agg(_concat(tables), keys_, specs_)
-
-            part = df._executor.run_coalesced(df._parts, direct)
+            part = df._executor.run_coalesced(
+                df._parts, direct, pre_concat=True
+            )
             return DataFrame([part], df._executor)
         # Fan-out scales with the cluster (the old hard cap of 8 was a
         # scaling cliff — VERDICT r1 weak 6).
@@ -943,6 +947,9 @@ class GroupedData:
         )
         if partial_bytes <= _COMBINE_COALESCE_BYTES or n_out == 1:
 
+            # NOT pre_concat: the partial-agg partitions are brand-new
+            # objects every run, so memoizing their concat would only
+            # fill the cache with dead entries.
             def merge_all(tables: List[pa.Table]) -> pa.Table:
                 from raydp_tpu.dataframe.executor import _concat
 
@@ -1144,8 +1151,12 @@ _AGG_COALESCE_BYTES = _env_bytes("RAYDP_TPU_AGG_COALESCE_BYTES", 128 << 20)
 _COMBINE_COALESCE_BYTES = _env_bytes(
     "RAYDP_TPU_COMBINE_COALESCE_BYTES", 64 << 20
 )
+# 64MB matches Spark AQE's default advisory partition size: below it a
+# hash exchange produces shuffle partitions smaller than Spark itself
+# would advise, so one coalesced task (arrow kernels thread internally,
+# and the gather-concat is memoized across repeated queries) wins.
 _EXCHANGE_COALESCE_BYTES = _env_bytes(
-    "RAYDP_TPU_EXCHANGE_COALESCE_BYTES", 32 << 20
+    "RAYDP_TPU_EXCHANGE_COALESCE_BYTES", 64 << 20
 )
 _BROADCAST_JOIN_BYTES = _env_bytes(
     "RAYDP_TPU_BROADCAST_JOIN_BYTES", 64 << 20

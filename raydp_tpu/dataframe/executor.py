@@ -17,6 +17,8 @@ from __future__ import annotations
 
 import itertools
 import os
+import threading
+from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, List, Optional, Sequence
 
@@ -25,6 +27,65 @@ import pyarrow as pa
 from raydp_tpu.store.object_store import ObjectRef, ObjectStore
 
 StageFn = Callable[[pa.Table], pa.Table]
+
+# Memoized gather-concat for coalesced runs (Spark's analog: shuffle
+# block reuse). Interactive ETL re-runs queries over the SAME stored
+# partitions; re-fetching and re-concatenating them rebuilds fresh
+# buffers each time, which also defeats every buffer-identity cache
+# downstream (the window engine's one-sort-per-spec frame cache keys on
+# buffer addresses). Keyed by partition identity (object ids / table
+# ids), LRU-bounded by bytes. Lives per PROCESS: in cluster mode the
+# memo sits in the ETL worker that coalesced runs route to (stable
+# majority-resident placement), in local mode in the driver.
+_CONCAT_MEMO_BYTES = int(
+    os.environ.get("RAYDP_TPU_CONCAT_CACHE_BYTES", 256 << 20)
+)
+_concat_memo: OrderedDict = OrderedDict()
+_concat_memo_lock = threading.Lock()
+
+
+def _fetch_concat_cached(ctx, refs) -> pa.Table:
+    """Worker-side gather for pre_concat coalesced runs: on a memo hit
+    the shm fetches are skipped along with the concat. Only ObjectRefs
+    are memoized — their object ids are globally unique, while id() of
+    a per-task unpickled raw ref could be recycled after GC and alias a
+    stale entry."""
+    if all(isinstance(r, ObjectRef) for r in refs):
+        key = tuple(r.object_id for r in refs)
+        with _concat_memo_lock:
+            ent = _concat_memo.get(key)
+            if ent is not None:
+                _concat_memo.move_to_end(key)
+                return ent[1]
+    else:
+        key = None
+    tables = [ctx.get_table(r) for r in refs]
+    return _concat_cached(tables, key)
+
+
+def _concat_cached(tables: List[pa.Table], key, keepalive=None) -> pa.Table:
+    """``_concat`` with identity-keyed memoization. ``keepalive`` pins
+    the objects whose ids form ``key`` (local mode: id() reuse after GC
+    would otherwise alias a stale entry)."""
+    if key is None:
+        return _concat(tables)
+    with _concat_memo_lock:
+        hit = _concat_memo.pop(key, None)
+        if hit is not None:
+            _concat_memo[key] = hit  # refresh LRU position
+            return hit[1]
+    out = _concat(tables)
+    # Entry cost: arrow's concat is zero-copy (the output references the
+    # input chunks' buffers), so ``out.nbytes`` already measures the
+    # retained memory and the keepalive pins only object headers on top.
+    cost = out.nbytes
+    with _concat_memo_lock:
+        _concat_memo[key] = (keepalive, out, cost)
+        total = sum(c for _, _, c in _concat_memo.values())
+        while total > _CONCAT_MEMO_BYTES and len(_concat_memo) > 1:
+            _, (_, _, evicted_cost) = _concat_memo.popitem(last=False)
+            total -= evicted_cost
+    return out
 
 
 class Executor:
@@ -70,13 +131,23 @@ class Executor:
         partitions are plain in-memory tables."""
 
     def run_coalesced(
-        self, parts: List[Any], fn: Callable[[List[pa.Table]], pa.Table]
+        self,
+        parts: List[Any],
+        fn: Callable[[Any], pa.Table],
+        pre_concat: bool = False,
     ) -> Any:
         """Run ``fn`` over ALL partitions in one task and return a single
         output partition. The adaptive small-data plan: when inputs (or
         partial-agg outputs) are small, one arrow kernel pass — which
         parallelizes internally across cores — beats a process-level
-        hash exchange whose per-task orchestration would dominate."""
+        hash exchange whose per-task orchestration would dominate.
+
+        ``pre_concat=True``: the executor concatenates the partitions
+        itself — memoized by partition identity (``_concat_cached``) so
+        repeated queries over the same stored partitions hand ``fn`` the
+        SAME table object (same buffers → downstream buffer-identity
+        caches hit) — and ``fn`` receives one ``pa.Table`` instead of a
+        list."""
         raise NotImplementedError
 
     def materialize(self, part: Any) -> pa.Table:
@@ -143,8 +214,12 @@ class LocalExecutor(Executor):
     def part_nbytes(self, part):
         return part.nbytes
 
-    def run_coalesced(self, parts, fn):
-        return fn(list(parts))
+    def run_coalesced(self, parts, fn, pre_concat=False):
+        if not pre_concat:
+            return fn(list(parts))
+        parts = list(parts)
+        key = ("local",) + tuple(id(t) for t in parts)
+        return fn(_concat_cached(parts, key, keepalive=parts))
 
     def materialize(self, part):
         return part
@@ -231,10 +306,19 @@ class ClusterExecutor(Executor):
             if isinstance(ref, ObjectRef):
                 self.store.delete(ref)
 
-    def run_coalesced(self, parts, fn):
-        def task(ctx, refs):
-            tables = [ctx.get_table(r) for r in refs]
-            return ctx.put_table(fn(tables), holder=True)
+    def run_coalesced(self, parts, fn, pre_concat=False):
+        if pre_concat:
+            def task(ctx, refs):
+                # _fetch_concat_cached is resolved in the WORKER's own
+                # executor module (pickled by reference), so the memo —
+                # and its lock — live worker-side and never ship.
+                return ctx.put_table(
+                    fn(_fetch_concat_cached(ctx, refs)), holder=True
+                )
+        else:
+            def task(ctx, refs):
+                tables = [ctx.get_table(r) for r in refs]
+                return ctx.put_table(fn(tables), holder=True)
 
         # Locality: run on the worker whose node holds the most input
         # bytes (one cross-node fetch per remote partition either way;

@@ -15,6 +15,14 @@ from raydp_tpu.parallel import MeshSpec
 from raydp_tpu.train import JAXEstimator, TrainingCallback
 
 
+@pytest.fixture(autouse=True)
+def _both_driver_modes(mode_session):
+    """Every test in this suite runs twice — under an in-process cluster
+    session and as a remote gRPC client driver (reference parity: its
+    whole suite runs direct AND ray://, conftest.py:42-49)."""
+    yield
+
+
 def _linear_df(n=2048, noise=0.05, seed=0, parts=4):
     """y = 2a - 3b + 1 + noise (like the reference's synthetic linear data,
     test_torch.py:28-48)."""

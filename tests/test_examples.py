@@ -10,6 +10,7 @@ EXAMPLES = [
     "data_process.py",
     "jax_nyctaxi.py",
     "torch_nyctaxi.py",
+    "tf_nyctaxi.py",
     "jax_titanic.py",
     "dlrm_criteo.py",
     "bert_glue.py",

@@ -8,6 +8,15 @@ import raydp_tpu.dataframe as rdf
 from raydp_tpu.data import MLDataset
 
 
+@pytest.fixture(autouse=True)
+def _both_driver_modes(mode_session):
+    """Every test here runs under an in-process cluster session AND a
+    remote gRPC client session (reference parity: conftest.py:42-49).
+    The cluster-lifecycle variant (holder survival across stop) lives in
+    test_multihost.py, which manages its own clusters."""
+    yield
+
+
 def _df(n=1000, parts=4):
     rng = np.random.default_rng(0)
     return rdf.from_pandas(
@@ -116,26 +125,17 @@ def test_bad_rank():
 
 
 def test_from_df_cluster_holder_refs():
-    import raydp_tpu
+    """Blocks of a cluster-built MLDataset are store refs, and shard
+    reads work from any rank (the stop-survival variant lives in
+    test_multihost.py::test_mldataset_holder_survives_stop)."""
+    ds = MLDataset.from_df(_df(400, 4), num_shards=2)
+    from raydp_tpu.store.object_store import ObjectRef
 
-    s = raydp_tpu.init(app_name="mlds", num_workers=2,
-                       memory_per_worker="256MB")
-    try:
-        ds = MLDataset.from_df(_df(400, 4), num_shards=2)
-        from raydp_tpu.store.object_store import ObjectRef
-
-        assert all(isinstance(b, ObjectRef) for b in ds.blocks)
-        loader = ds.to_jax(["a", "b"], "label", batch_size=100, rank=1,
-                           shuffle=False)
-        total = sum(x.shape[0] for x, _ in loader)
-        assert total == ds.rows_per_shard
-        # Shards survive worker teardown (holder ownership).
-        raydp_tpu.stop(del_obj_holder=False)
-        loader2 = ds.to_jax(["a"], "label", batch_size=100, rank=0,
-                            shuffle=False)
-        assert sum(x.shape[0] for x, _ in loader2) == ds.rows_per_shard
-    finally:
-        raydp_tpu.stop()
+    assert all(isinstance(b, ObjectRef) for b in ds.blocks)
+    loader = ds.to_jax(["a", "b"], "label", batch_size=100, rank=1,
+                       shuffle=False)
+    total = sum(x.shape[0] for x, _ in loader)
+    assert total == ds.rows_per_shard
 
 
 def test_loader_int64_dtype_exact():

@@ -182,3 +182,58 @@ def test_mldataset_and_estimator_across_hosts(twohost):
     )
     history = est.fit_on_df(df)
     assert history[-1]["train_loss"] < history[0]["train_loss"]
+
+
+def test_mldataset_holder_survives_stop():
+    """Holder-owned MLDataset blocks outlive worker teardown
+    (stop(del_obj_holder=False)) and stay readable — moved here from
+    test_ml_dataset.py, which now runs under shared dual-mode sessions
+    and must not manage cluster lifecycle itself."""
+    rng = np.random.default_rng(0)
+    pdf = pd.DataFrame(
+        {
+            "a": rng.standard_normal(400),
+            "b": rng.standard_normal(400),
+            "label": rng.standard_normal(400),
+        }
+    )
+    raydp_tpu.init(app_name="mlds-holder", num_workers=2,
+                   memory_per_worker="256MB")
+    try:
+        ds = MLDataset.from_df(
+            rdf.from_pandas(pdf, num_partitions=4), num_shards=2
+        )
+        loader = ds.to_jax(["a", "b"], "label", batch_size=100, rank=1,
+                           shuffle=False)
+        assert sum(x.shape[0] for x, _ in loader) == ds.rows_per_shard
+        # Shards survive worker teardown (holder ownership).
+        raydp_tpu.stop(del_obj_holder=False)
+        loader2 = ds.to_jax(["a"], "label", batch_size=100, rank=0,
+                            shuffle=False)
+        assert sum(x.shape[0] for x, _ in loader2) == ds.rows_per_shard
+    finally:
+        raydp_tpu.stop()
+
+
+def test_refs_survive_worker_churn():
+    """Refs handed across the boundary stay readable after the pool
+    shrinks (holder ownership) — the from_refs frame keeps working.
+    Moved from test_reverse_path.py: killing a worker must not mutate
+    the shared dual-mode session that suite runs on."""
+    session = raydp_tpu.init(app_name="revpath-churn", num_workers=2)
+    try:
+        rng = np.random.default_rng(3)
+        pdf = pd.DataFrame(
+            {"i": np.arange(100, dtype=np.int64),
+             "v": rng.standard_normal(100)}
+        )
+        refs = rdf.from_pandas(pdf, num_partitions=2).to_object_refs()
+        victim = session.cluster.alive_workers()[0].worker_id
+        session.cluster.kill_worker(victim)
+        out = (
+            rdf.from_refs(refs).to_pandas()
+            .sort_values("i").reset_index(drop=True)
+        )
+        pd.testing.assert_frame_equal(out, pdf)
+    finally:
+        raydp_tpu.stop()

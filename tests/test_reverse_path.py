@@ -16,10 +16,11 @@ from raydp_tpu.data import MLDataset
 
 
 @pytest.fixture()
-def session():
-    s = raydp_tpu.init(app_name="revpath-test", num_workers=2)
-    yield s
-    raydp_tpu.stop()
+def session(mode_session):
+    """Every reverse-path test runs under an in-process cluster session
+    AND a remote gRPC client session (reference parity: its whole suite
+    runs direct and ray://, conftest.py:42-49)."""
+    yield mode_session
 
 
 def _typed_pdf(n=400):
@@ -90,15 +91,10 @@ def test_from_refs_validation(session):
         rdf.from_refs([pa.table({"x": [1]})])
 
 
-def test_refs_survive_into_new_frame_after_worker_churn(session):
-    """Refs handed across the boundary stay readable after the pool
-    shrinks (holder ownership) — the from_refs frame keeps working."""
-    pdf = _typed_pdf(100)
-    refs = rdf.from_pandas(pdf, num_partitions=2).to_object_refs()
-    victim = session.cluster.alive_workers()[0].worker_id
-    session.cluster.kill_worker(victim)
-    out = rdf.from_refs(refs).to_pandas().sort_values("i").reset_index(drop=True)
-    pd.testing.assert_frame_equal(out, pdf)
+# NOTE: the worker-churn variant (refs survive kill_worker) lives in
+# test_multihost.py::test_refs_survive_worker_churn — it mutates the
+# worker pool, so it owns its cluster instead of the shared dual-mode
+# session every test here runs on.
 
 
 def test_mldataset_from_refs(session):
