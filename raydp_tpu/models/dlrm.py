@@ -16,7 +16,9 @@ TPU-first design:
   GSPMD partitions it locally and inserts one ``psum`` over ``tp`` — the
   canonical sharded-embedding-lookup collective, and it runs on the MXU
   instead of the scatter/gather units. ``'take'`` keeps small tables
-  replicated with a plain gather; ``'auto'`` switches on vocab size.
+  replicated with a plain gather; ``'auto'`` switches on vocab size AND
+  backend (accelerators only — on CPU the one-hot is pure flop
+  inflation, so auto always gathers there).
 * **Dot-product feature interaction** with static lower-triangle
   indices (no dynamic shapes), bf16 through the trunk, f32 logits.
 * Multi-hot bags: pass ids ``[B, n_tables, L]`` with sum/mean pooling —
@@ -71,7 +73,20 @@ class DLRMConfig:
     def impl_for(self, vocab: int) -> str:
         if self.embedding_impl != "auto":
             return self.embedding_impl
-        return "onehot" if vocab >= AUTO_ONEHOT_THRESHOLD else "take"
+        if vocab < AUTO_ONEHOT_THRESHOLD:
+            return "take"
+        # The one-hot contraction is an ACCELERATOR trade: it moves the
+        # lookup onto the MXU and gives GSPMD a contracting dim to
+        # partition (one psum over tp). On CPU the [B, V] one-hot is
+        # pure flop inflation — a 10k-vocab table turns a gather into a
+        # ~2.6 GMAC matmul per step (measured 5x whole-model slowdown in
+        # the CPU-fallback DLRM bench). Auto therefore consults the
+        # backend; the CPU-mesh sharding test pins impl='onehot'
+        # explicitly (tests/test_dlrm.py::test_sharded_tables_on_tp_mesh)
+        # so that path keeps end-to-end coverage without a TPU.
+        import jax
+
+        return "onehot" if jax.default_backend() != "cpu" else "take"
 
 
 def _mlp_init(*logical_axes):
@@ -235,7 +250,10 @@ def criteo_dlrm(**overrides) -> DLRMConfig:
 
 
 def tiny_dlrm(**overrides) -> DLRMConfig:
-    """Small config for tests/dry runs."""
+    """Small config for tests/dry runs. With the default
+    ``embedding_impl='auto'`` every table resolves to ``take`` on CPU
+    hosts (backend-aware auto); pass ``embedding_impl='onehot'`` to
+    exercise the sharded-contraction path on a CPU mesh."""
     defaults = dict(
         dense_features=4,
         vocab_sizes=(64, 10_000, 128, 32),   # mixes take + onehot paths

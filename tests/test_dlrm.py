@@ -77,8 +77,12 @@ def test_multihot_bag_pooling():
 
 def test_sharded_tables_on_tp_mesh(eight_cpu_devices):
     """Vocab-sharded tables over tp produce the same logits as a single
-    replicated device, with the big table actually sharded."""
-    cfg = tiny_dlrm(dtype=jnp.float32)
+    replicated device, with the big table actually sharded.
+
+    embedding_impl is PINNED to 'onehot': 'auto' resolves by backend
+    (take on CPU), but this test exists to exercise the sharded one-hot
+    contraction + psum path on the CPU mesh — the path a real TPU uses."""
+    cfg = tiny_dlrm(dtype=jnp.float32, embedding_impl="onehot")
     model = DLRM(cfg)
     dense, sparse = _batch(cfg, b=8, seed=2)
     import flax.linen as nn
