@@ -474,12 +474,26 @@ class Cluster:
                     return reply["result"]
                 except grpc.RpcError as exc:
                     code = exc.code()
-                    # Only connectivity loss means the worker is gone and
-                    # the task is retriable elsewhere; a DEADLINE_EXCEEDED
+                    # Connectivity loss (UNAVAILABLE) or a server that shut
+                    # down with our call in flight (CANCELLED — a worker
+                    # exiting tears down its gRPC server and cancels open
+                    # RPCs) both mean the worker is gone and the idempotent
+                    # stage task is retriable elsewhere; a DEADLINE_EXCEEDED
                     # is a slow task on a healthy worker and must not
                     # unlink its objects or re-run the work.
+                    # ...except when WE initiated the teardown: shutdown
+                    # closes worker channels with calls possibly in
+                    # flight, and those surface as CANCELLED too —
+                    # re-running their tasks on surviving workers would
+                    # duplicate side effects and stall the teardown.
+                    if self._elastic_stop.is_set():
+                        raise ClusterError(
+                            f"task RPC to worker {target} failed: {code} "
+                            "(cluster is shutting down)"
+                        ) from exc
                     if (
-                        code == grpc.StatusCode.UNAVAILABLE
+                        code in (grpc.StatusCode.UNAVAILABLE,
+                                 grpc.StatusCode.CANCELLED)
                         and self.master is not None
                     ):
                         self.master.mark_worker_dead(

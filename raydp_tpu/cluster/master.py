@@ -210,7 +210,25 @@ class AppMaster:
         return {}
 
     def _on_register_object(self, req: dict) -> dict:
-        self.store.register_ref(req["ref"])
+        ref = req["ref"]
+        # A worker this master already wrote off (disowned mid-task but
+        # still finishing — the heartbeat-starvation survival path) may
+        # register worker-owned objects whose segments were unlinked the
+        # moment it was marked dead. Registering such a ref would hand
+        # later readers a pointer to deleted storage; fail the task
+        # loudly here instead (holder-owned refs — every DataFrame stage
+        # output — are unaffected: the holder never "dies").
+        owner = getattr(ref, "owner", None)
+        if owner is not None and owner != OWNER_HOLDER:
+            with self._lock:
+                info = self._workers.get(owner)
+                dead = info is not None and info.state != "ALIVE"
+            if dead:
+                raise RuntimeError(
+                    f"owner {owner} was marked dead; its objects were "
+                    "unlinked — refusing to register a dangling ref"
+                )
+        self.store.register_ref(ref)
         return {}
 
     def _on_put_object(self, req: dict) -> dict:
@@ -274,14 +292,41 @@ class AppMaster:
 
     # -- monitor --------------------------------------------------------
     def _monitor_loop(self) -> None:
+        prev = time.monotonic()
         while not self._monitor_stop.wait(1.0):
             now = time.monotonic()
+            prev = self._monitor_tick(now, prev)
+
+    def _monitor_tick(self, now: float, prev: float) -> float:
+        """One liveness pass; returns the new ``prev`` timestamp.
+
+        Self-stall defense: if the loop overslept its 1 s period (driver
+        process GIL-starved by a big shuffle on a small host), the
+        workers' heartbeats were starved by the same cause — their
+        staleness is evidence of OUR stall, not their death. Grant the
+        oversleep back as grace instead of declaring a massacre.
+        """
+        oversleep = (now - prev) - 1.0
+        if oversleep > 2.0:
             with self._lock:
-                stale = [
-                    w.worker_id
-                    for w in self._workers.values()
-                    if w.state == "ALIVE"
-                    and now - w.last_heartbeat > HEARTBEAT_TIMEOUT_S
-                ]
-            for worker_id in stale:
-                self.mark_worker_dead(worker_id, reason="heartbeat timeout")
+                for w in self._workers.values():
+                    if w.state == "ALIVE":
+                        # Clamped: grace covers staleness accrued DURING
+                        # the stall; a beat processed near the stall's
+                        # end must not end up timestamped in the future
+                        # (that would slow genuine death detection by up
+                        # to the stall length afterwards).
+                        w.last_heartbeat = min(
+                            now, w.last_heartbeat + oversleep
+                        )
+            return now
+        with self._lock:
+            stale = [
+                w.worker_id
+                for w in self._workers.values()
+                if w.state == "ALIVE"
+                and now - w.last_heartbeat > HEARTBEAT_TIMEOUT_S
+            ]
+        for worker_id in stale:
+            self.mark_worker_dead(worker_id, reason="heartbeat timeout")
+        return now

@@ -94,6 +94,13 @@ class Worker:
         self.store: ObjectStore = None  # namespace learned at registration
         self.ctx: WorkerContext = None
         self._stop_event = threading.Event()
+        # Tasks in flight right now. A worker mid-task must never decide
+        # the master is gone and exit: on a core-starved host (one CPU,
+        # many shuffle processes) heartbeat round-trips stall for tens of
+        # seconds precisely WHILE tasks run, and a mid-task exit cancels
+        # the in-flight RunTask on the driver side.
+        self._busy = 0
+        self._busy_lock = threading.Lock()
         # The RPC server is up before registration completes, and the master
         # lists this worker ALIVE the moment RegisterWorker returns — so a
         # task can arrive while ctx is still being built. Gate on readiness.
@@ -152,12 +159,17 @@ class Worker:
         fn = cloudpickle.loads(req["fn"])
         args = req.get("args", ())
         kwargs = req.get("kwargs", {})
+        with self._busy_lock:
+            self._busy += 1
         try:
             result = fn(self.ctx, *args, **kwargs)
             return {"result": result}
         except Exception:
             # Let RpcServer._wrap serialize the failure uniformly.
             raise
+        finally:
+            with self._busy_lock:
+                self._busy -= 1
 
     def _on_stop(self, req: dict) -> dict:
         # Register the objects this worker still owns with the master before
@@ -173,12 +185,17 @@ class Worker:
             reply = self.master.try_call(
                 "Heartbeat", {"worker_id": self.worker_id}, timeout=8.0
             )
+            with self._busy_lock:
+                busy = self._busy > 0
             if reply is None:
                 # Transient master hiccups — including a driver process
                 # saturated by a big shuffle on a small host — are
-                # absorbed; only a sustained outage means exit.
+                # absorbed; only a sustained outage means exit. And never
+                # while a task is executing: a starved master during a
+                # shuffle is the NORM on small hosts, and exiting here
+                # cancels the very task the driver is waiting on.
                 missed += 1
-                if missed >= 8:
+                if missed >= 8 and not busy:
                     logger.warning(
                         "worker %s: master unreachable for %d beats; exiting",
                         self.worker_id, missed,
@@ -187,6 +204,16 @@ class Worker:
                 continue
             missed = 0
             if not reply.get("known", False):
+                if busy:
+                    # The master wrote us off (its monitor starved while
+                    # our heartbeats queued) but the driver's task RPC to
+                    # us is still open — finish it; the result makes it
+                    # back on that same channel. Exit once idle.
+                    logger.warning(
+                        "worker %s: master disowned us mid-task; finishing "
+                        "in-flight work before exiting", self.worker_id,
+                    )
+                    continue
                 # Master explicitly wrote us off — exit now (parity with
                 # executor exit on AppMaster disconnect).
                 logger.warning("worker %s: master disowned us; exiting",

@@ -1,0 +1,137 @@
+"""Heartbeat-race regression: a core-starved driver must not turn a
+busy worker into a cancelled task (VERDICT r3 evidence round, observed
+as ``task RPC to worker w0 failed: StatusCode.CANCELLED`` in the ETL
+groupby bench on a 1-CPU host).
+
+The failure chain being pinned down:
+
+  1. big shuffle saturates the only core → the driver-side master's
+     heartbeat handlers starve → worker heartbeats go unanswered,
+  2. the master's monitor (or the worker's own missed-beat budget)
+     declares death while the worker is mid-task,
+  3. the worker exits, its gRPC server cancels the in-flight RunTask,
+  4. the driver sees CANCELLED and (pre-fix) raised instead of
+     retrying.
+
+Reference behavior class: executor disconnect handling
+(RayAppMaster.scala:184-186) — but the reference never runs its control
+plane and its data plane on the same starved core, so this failure mode
+is specific to this framework's single-host topology and gets its own
+suite.
+"""
+import threading
+import time
+
+import grpc
+import pytest
+
+import raydp_tpu
+
+
+def _session(n=2, **kw):
+    return raydp_tpu.init(app_name="hb-race", num_workers=n, **kw)
+
+
+def test_disowned_worker_finishes_in_flight_task():
+    """Master writes a worker off mid-task (the monitor-starvation
+    outcome); the worker must finish the task — the result rides the
+    still-open RunTask channel — instead of exiting and cancelling it."""
+    s = _session(n=1)
+    try:
+        wid = s.cluster.alive_workers()[0].worker_id
+
+        def slow_task(ctx):
+            time.sleep(4.0)
+            return "survived"
+
+        fut = s.cluster.submit_async(slow_task, timeout=60.0)
+        time.sleep(1.0)  # task is in flight on the worker now
+        s.cluster.master.mark_worker_dead(wid, reason="test disown")
+        assert fut.result(timeout=30.0) == "survived"
+    finally:
+        raydp_tpu.stop()
+
+
+def test_cancelled_rpc_is_retried_on_another_worker():
+    """A worker whose server shuts down with our call in flight yields
+    CANCELLED — the idempotent stage task must re-run elsewhere, exactly
+    like UNAVAILABLE (connectivity loss)."""
+    s = _session(n=2)
+    try:
+        workers = sorted(w.worker_id for w in s.cluster.alive_workers())
+        victim = workers[0]
+        victim_client = s.cluster._client_for(victim)
+        assert victim_client is not None
+
+        class _Cancelled(grpc.RpcError):
+            def code(self):
+                return grpc.StatusCode.CANCELLED
+
+            def details(self):
+                return "injected: server shut down mid-call"
+
+        real_call = victim_client.call
+        fired = threading.Event()
+
+        def flaky_call(method, request=None, timeout=None):
+            if method == "RunTask" and not fired.is_set():
+                fired.set()
+                raise _Cancelled()
+            return real_call(method, request, timeout)
+
+        victim_client.call = flaky_call
+        try:
+            out = s.cluster.submit(
+                lambda ctx: "ok", worker_id=victim, timeout=30.0
+            )
+        finally:
+            victim_client.call = real_call
+        assert out == "ok"
+        assert fired.is_set(), "injected CANCELLED never fired"
+        # the victim was written off as gone — the retry ran elsewhere
+        alive = {w.worker_id for w in s.cluster.alive_workers()}
+        assert victim not in alive
+    finally:
+        raydp_tpu.stop()
+
+
+def test_monitor_grants_grace_after_its_own_stall():
+    """A monitor tick that overslept (driver GIL-starved) must hand the
+    oversleep back as heartbeat grace instead of declaring a massacre:
+    worker staleness during OUR stall is evidence of the stall, not of
+    worker death. Driven through ``_monitor_tick`` directly — the live
+    loop's timing can't be starved deterministically from a test."""
+    from raydp_tpu.cluster import master as master_mod
+
+    s = _session(n=1)
+    try:
+        m = s.cluster.master
+        wid = s.cluster.alive_workers()[0].worker_id
+        # Park the live monitor thread: between this test's stale write
+        # and its manual tick, a concurrent real tick (whose prev IS one
+        # period ago) would legitimately declare death and race the
+        # assertion. Manual ticks drive the logic from here on.
+        m._monitor_stop.set()
+        time.sleep(1.2)
+        stall = master_mod.HEARTBEAT_TIMEOUT_S + 20.0
+        with m._lock:
+            info = m._workers[wid]
+            # The beat arrived just before the stall began...
+            info.last_heartbeat = time.monotonic() - stall
+        now = time.monotonic()
+        # ...and the monitor's previous tick was ``stall`` ago too: the
+        # whole staleness window is the monitor's own oversleep.
+        prev = m._monitor_tick(now, now - stall)
+        assert prev == now
+        assert wid in {w.worker_id for w in s.cluster.alive_workers()}, (
+            "monitor blamed its own stall on the worker"
+        )
+        # Same staleness WITHOUT an oversleep (prev one period ago) is a
+        # genuinely dead worker and must still be declared dead — the
+        # grace path must not blunt real failure detection.
+        with m._lock:
+            m._workers[wid].last_heartbeat = time.monotonic() - stall
+        m._monitor_tick(time.monotonic(), time.monotonic() - 1.0)
+        assert wid not in {w.worker_id for w in s.cluster.alive_workers()}
+    finally:
+        raydp_tpu.stop()
