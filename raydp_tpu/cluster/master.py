@@ -220,14 +220,21 @@ class AppMaster:
         # output — are unaffected: the holder never "dies").
         owner = getattr(ref, "owner", None)
         if owner is not None and owner != OWNER_HOLDER:
+            # check + register under ONE lock hold: mark_worker_dead
+            # flips state to DEAD under this lock and only unlinks
+            # afterwards, so with the lock held across both steps a
+            # registration lands either strictly before the DEAD
+            # transition (the subsequent on_owner_died unlinks it) or
+            # after (this raises) — never in between as a dangling ref.
             with self._lock:
                 info = self._workers.get(owner)
-                dead = info is not None and info.state != "ALIVE"
-            if dead:
-                raise RuntimeError(
-                    f"owner {owner} was marked dead; its objects were "
-                    "unlinked — refusing to register a dangling ref"
-                )
+                if info is not None and info.state != "ALIVE":
+                    raise RuntimeError(
+                        f"owner {owner} was marked dead; its objects were "
+                        "unlinked — refusing to register a dangling ref"
+                    )
+                self.store.register_ref(ref)
+            return {}
         self.store.register_ref(ref)
         return {}
 
@@ -319,7 +326,12 @@ class AppMaster:
                         w.last_heartbeat = min(
                             now, w.last_heartbeat + oversleep
                         )
-            return now
+            # Fall through to the stale check: under CHRONIC oversleep
+            # (every tick >3 s for many minutes) net staleness still
+            # accumulates tick by tick, and skipping the check here
+            # would blind death detection for the whole episode — a
+            # remote worker that hard-hung at its start would keep
+            # receiving tasks indefinitely.
         with self._lock:
             stale = [
                 w.worker_id

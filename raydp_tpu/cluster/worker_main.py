@@ -154,14 +154,19 @@ class Worker:
         )
 
     def _on_run_task(self, req: dict) -> dict:
-        if not self._ready.wait(timeout=15.0):
-            raise RuntimeError("worker context not ready (registration hung)")
-        fn = cloudpickle.loads(req["fn"])
-        args = req.get("args", ())
-        kwargs = req.get("kwargs", {})
+        # Busy goes up FIRST: between this handler starting and fn
+        # deserializing, the heartbeat thread must already see the task
+        # — an exit decision in that setup window would cancel it.
         with self._busy_lock:
             self._busy += 1
         try:
+            if not self._ready.wait(timeout=15.0):
+                raise RuntimeError(
+                    "worker context not ready (registration hung)"
+                )
+            fn = cloudpickle.loads(req["fn"])
+            args = req.get("args", ())
+            kwargs = req.get("kwargs", {})
             result = fn(self.ctx, *args, **kwargs)
             return {"result": result}
         except Exception:
@@ -199,6 +204,19 @@ class Worker:
                     logger.warning(
                         "worker %s: master unreachable for %d beats; exiting",
                         self.worker_id, missed,
+                    )
+                    break
+                if missed >= 60:
+                    # Hard cap even while busy: with the driver truly
+                    # gone AND the task wedged (user-code deadlock),
+                    # nothing else can ever kill this process — without
+                    # a bound it would orphan forever with its shm
+                    # segments. 60 beats ≈ several minutes of sustained
+                    # outage, far beyond any GIL stall.
+                    logger.error(
+                        "worker %s: master unreachable for %d beats with "
+                        "a task still in flight; exiting to avoid an "
+                        "immortal orphan", self.worker_id, missed,
                     )
                     break
                 continue

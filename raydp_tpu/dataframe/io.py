@@ -49,13 +49,30 @@ def _scan_distributed(split_specs, reader) -> Optional[DataFrame]:
     return DataFrame([f.result() for f in futures], ex)
 
 
+def _compact(t: pa.Table) -> pa.Table:
+    """Rebuild ``t`` on its own buffers via an IPC round-trip.
+
+    ``Table.slice`` is zero-copy: the slice keeps the PARENT's buffers,
+    and pickle serializes those in full — so shipping N slices of one
+    table to the workers moves N× the whole table over the control
+    plane, not 1× (measured: a 4.5 MB slice of a 36 MB table pickles at
+    36 MB; with 8 partitions that is 288 MB of ingest traffic and the
+    driver-side stall that starves worker heartbeats). The IPC writer
+    truncates buffers to the slice, so one memcpy-speed round-trip makes
+    the partition self-contained before it is pickled into a task."""
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, t.schema) as w:
+        w.write_table(t)
+    return pa.ipc.open_stream(sink.getvalue()).read_all()
+
+
 def from_arrow(table: pa.Table, num_partitions: int = 1) -> DataFrame:
     if num_partitions <= 1:
         return _distribute([table])
     sizes = _split_sizes(table.num_rows, num_partitions)
     parts, offset = [], 0
     for size in sizes:
-        parts.append(table.slice(offset, size))
+        parts.append(_compact(table.slice(offset, size)))
         offset += size
     return _distribute(parts)
 
