@@ -127,6 +127,17 @@ def _steady(history):
     return sum(e["samples_per_sec"] for e in steady) / len(steady)
 
 
+def _best_of_2_fit(est, ds):
+    """Best-of-2 steady rate. Single-run rates swing ±10% on shared
+    hosts. fit() returns the estimator's CUMULATIVE history (the same
+    list object), so run 1 is snapshotted and run 2 sliced to its own
+    epochs; _steady then drops each run's first epoch (run 2 re-jits
+    too)."""
+    h1 = list(est.fit(ds))
+    h2 = est.fit(ds)[len(h1):]
+    return max(_steady(h1), _steady(h2))
+
+
 def _torch_rate(model, make_batch, n_batches=4, loss="mse"):
     """Steady samples/s of a torch CPU train loop (reference mechanism
     class); first batch is warmup."""
@@ -266,12 +277,15 @@ BERT_SEQ = 128
 BERT_BATCH = 32
 
 
-def _bert_sweep(make_cfg, batches=(32, 64, 128), impls=("dense", "flash")):
+def _bert_sweep(make_cfg, batches=(32, 64, 128), impls=("dense", "flash"),
+                include_remat=True, skip=()):
     """Raw train-step throughput over (batch, attention impl, remat):
     the MFU levers the r2 verdict asked to sweep (tunnel-blocked then).
     Remat variants run at the largest batch only — that is where
-    memory-bound configs need the FLOPs-for-HBM trade. Returns
-    (table, best_batch, best_impl_config)."""
+    memory-bound configs need the FLOPs-for-HBM trade. ``skip`` holds
+    combo tags already measured elsewhere (the pre-fit impl probe) so
+    they are not paid twice. Returns (table, best_batch,
+    best_impl_config)."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -282,7 +296,12 @@ def _bert_sweep(make_cfg, batches=(32, 64, 128), impls=("dense", "flash")):
     table = {}
     best = (None, None, 0.0)
     combos = [(impl, False, b) for impl in impls for b in batches]
-    combos += [(impl, True, max(batches)) for impl in impls]
+    if include_remat:
+        combos += [(impl, True, max(batches)) for impl in impls]
+    combos = [
+        (impl, remat, b) for impl, remat, b in combos
+        if f"{impl}{'_remat' if remat else ''}_b{b}" not in skip
+    ]
     for impl, remat, batch in combos:
         cfg = make_cfg(impl, remat)
         model = SequenceClassifier(cfg=cfg, num_classes=2)
@@ -341,34 +360,35 @@ def bench_bert():
             max_len=BERT_SEQ, dropout_rate=0.1, dtype=jnp.float32
         )
     else:
-        cfg = bert_base(max_len=BERT_SEQ, dropout_rate=0.1)
-        # On the real chip: find the throughput-best (batch, attention)
-        # before the estimator run, and use it.
-        sweep, best_batch, best_cfg = _bert_sweep(
-            lambda impl, remat: bert_base(
-                max_len=BERT_SEQ, dropout_rate=0.1, attention_impl=impl,
-                remat=remat,
-            )
+        # On chip the FIT comes first-ish — it carries the headline
+        # samples/s + MFU the round is judged on; the full sweep runs
+        # after with whatever budget remains (r4 lesson: the 8-combo
+        # sweep-first burned the whole chip window in tunnel-slowed
+        # compiles and the fit never ran). Batch 128 over batch 32:
+        # bigger per-step GEMMs are strictly better for MXU utilisation
+        # at seq 128. The one lever worth 2 compiles up front is the
+        # attention impl — a 2-combo probe picks dense vs flash for the
+        # fit instead of guessing (deadline-guarded like the sweep).
+        bert_batch = 128
+        impl = "dense"
+        probe, _, probe_best = _bert_sweep(
+            lambda i, r: bert_base(
+                max_len=BERT_SEQ, dropout_rate=0.1, attention_impl=i,
+                remat=r,
+            ),
+            batches=(bert_batch,),
+            include_remat=False,
         )
-        if best_batch is not None:
-            bert_batch = best_batch
-            best_impl, best_remat = best_cfg
-            cfg = bert_base(
-                max_len=BERT_SEQ,
-                dropout_rate=0.1,
-                attention_impl=best_impl,
-                remat=best_remat,
-            )
+        if probe_best is not None:
+            impl = probe_best[0]
+        cfg = bert_base(
+            max_len=BERT_SEQ, dropout_rate=0.1, attention_impl=impl
+        )
     if _over_deadline(margin=120.0):
-        # The estimator fit is minutes of work; report the sweep table
-        # (whatever of it ran) rather than blowing the bench window.
-        return {
-            "skipped": "bench deadline before estimator fit",
-            "batch_sweep_samples_per_sec": sweep,
-        }
+        return {"skipped": "bench deadline before estimator fit"}
     model = SequenceClassifier(cfg=cfg, num_classes=2)
     n_rows = 20 * bert_batch
-    bert_epochs = 5 if _CPU_FALLBACK else 3  # more steady epochs vs noise
+    bert_epochs = 7 if _CPU_FALLBACK else 3  # more steady epochs vs noise
     rs = np.random.RandomState(0)
     ids = rs.randint(0, cfg.vocab_size, size=(n_rows, BERT_SEQ)).astype(
         np.int32
@@ -393,15 +413,13 @@ def bench_bert():
         # default threefry PRNG; rbg is also the partitionable impl on
         # multi-chip meshes.
         rng_impl="rbg",
+        # One dispatch per epoch (dataset is small enough to live on
+        # device): measured +7% over the streaming loop on CPU, and on
+        # chip it removes every per-step host round-trip over the
+        # tunnel.
+        epoch_mode="scan",
     )
-    # Best-of-2 fits (like the ETL benches' best-of-3): single-run rates
-    # swing ±10% on shared hosts, and the ratio was measuring that.
-    # fit() returns the estimator's CUMULATIVE history (same list
-    # object), so snapshot run 1 and slice run 2 to its own epochs —
-    # _steady then drops each run's first epoch (run 2 re-jits too).
-    h1 = list(est.fit(ds))
-    h2 = est.fit(ds)[len(h1):]
-    ours = max(_steady(h1), _steady(h2))
+    ours = _best_of_2_fit(est, ds)
     n_params = _param_count(est._state.params)
     # Train FLOPs/sample ≈ 3 × forward; forward = 2·N·S (param matmuls)
     # + 4·L·S²·d (attention scores + values).
@@ -409,6 +427,30 @@ def bench_bert():
     flops_per_sample = 3 * fwd
 
     base = max(_bert_torch_baseline(cfg), _bert_torch_baseline(cfg))
+    if not _CPU_FALLBACK:
+        # The estimator's bert-base state (params + adamw moments + the
+        # scan-mode device-resident dataset) is dead weight now; free
+        # the HBM before the sweep inits its own full models.
+        n_est = est
+        est = None
+        del n_est
+    if not _CPU_FALLBACK and not _over_deadline(margin=180.0):
+        # Post-fit sweep with leftover budget only — the MFU-lever table
+        # the r2 verdict asked for, trimmed by default to remat at the
+        # fit batch (the impl probe above covered the non-remat combos).
+        # RAYDP_TPU_FULL_SWEEP=1 restores the full grid.
+        full = os.environ.get("RAYDP_TPU_FULL_SWEEP") == "1"
+        sweep, _, _ = _bert_sweep(
+            lambda impl, remat: bert_base(
+                max_len=BERT_SEQ, dropout_rate=0.1, attention_impl=impl,
+                remat=remat,
+            ),
+            batches=(32, 64, 128) if full else (bert_batch,),
+            skip=set(probe),
+        )
+        sweep = {**probe, **sweep}
+    elif not _CPU_FALLBACK:
+        sweep = probe
     out = {
         "samples_per_sec": round(ours, 2),
         "unit": "samples/s",
@@ -538,7 +580,7 @@ def bench_dlrm():
         # vocab here is < 2^24.
         epoch_mode="scan",
     )
-    ours = _steady(est.fit(ds))
+    ours = _best_of_2_fit(est, ds)
     # MFU over the dense-matmul FLOPs (embedding lookups are
     # bandwidth-bound, not MXU work).
     import jax.tree_util as jtu
@@ -548,7 +590,7 @@ def bench_dlrm():
         for p, x in jtu.tree_leaves_with_path(est._state.params)
         if "emb_" not in jtu.keystr(p)
     )
-    base = _dlrm_torch_baseline(cfg)
+    base = max(_dlrm_torch_baseline(cfg), _dlrm_torch_baseline(cfg))
     return {
         "samples_per_sec": round(ours, 1),
         "unit": "samples/s",
@@ -1084,11 +1126,15 @@ CPU_MATRIX = [
 # re-run here. Ingest runs right after the headline config, before the
 # big-model configs can pressure host memory.
 CHIP_MATRIX_NAMES = [
+    # Cheap configs first: the BERT config (sweep + fit, many XLA
+    # compiles over a possibly-slow tunnel) runs LAST so a tight chip
+    # budget degrades to "no sweep", never to "no dlrm/titanic numbers"
+    # (r4 observation: bert third in this list ate the whole window).
     "nyctaxi_mlp",
     "ingest_device_feed",
-    "bert_glue",
-    "dlrm_criteo",
     "titanic_classifier",
+    "dlrm_criteo",
+    "bert_glue",
     "longcontext_seq_scaling",
     "dlrm_embedding_study",
     "dlrm_criteo_scale",
@@ -1416,7 +1462,13 @@ def main(argv=None):
 
     # Keep ~chip_cap of runway once the probe has a live device; the
     # chip numbers outrank the tail of the (small-size) CPU matrix.
-    for name, fn in CPU_MATRIX:
+    # RAYDP_TPU_SKIP_CPU=1 skips straight to the chip phase — the
+    # operator loop for re-validating chip configs after a tunnel wedge
+    # without paying the CPU matrix again.
+    cpu_matrix = (
+        [] if os.environ.get("RAYDP_TPU_SKIP_CPU") == "1" else CPU_MATRIX
+    )
+    for name, fn in cpu_matrix:
         remaining = bench_deadline - time.monotonic()
         if probe is not None and probe.ok.is_set() and remaining < chip_cap:
             _STATE["notes"].append(
