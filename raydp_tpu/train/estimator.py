@@ -212,6 +212,7 @@ class JAXEstimator:
         self._resume_position = None
         self._train_step = None
         self._eval_step = None
+        self._predict_step = None
         self.history: List[Dict[str, float]] = []
 
     # -- mesh / state setup ---------------------------------------------
@@ -341,10 +342,22 @@ class JAXEstimator:
                 out[name] = fn(preds, target)
             return out
 
+        def predict_step(state: TrainState, x):
+            if use_aux:
+                # Sown collections (MoE aux losses) are training
+                # bookkeeping; inference wants the predictions only.
+                preds, _ = state.apply_fn(
+                    state.params, x, mutable=["losses"]
+                )
+            else:
+                preds = state.apply_fn(state.params, x)
+            return preds
+
         self._train_step = jax.jit(
             train_step, donate_argnums=(0,) if self.donate_state else ()
         )
         self._eval_step = jax.jit(eval_step)
+        self._predict_step = jax.jit(predict_step)
 
     def _model_takes_deterministic(self) -> bool:
         import inspect
@@ -863,11 +876,95 @@ class JAXEstimator:
         return self._model, params
 
     def predict(self, x: np.ndarray) -> np.ndarray:
+        """Jitted batched inference on a host array. Chunks of
+        ``batch_size`` stream through the same sharded device path as
+        training; the ragged tail chunk is cycled-padded back up to
+        ``batch_size`` so every dispatch reuses ONE compiled shape (a
+        per-tail-shape recompile costs more than the padded rows)."""
         if self._state is None:
             raise RuntimeError("no trained state; call fit() first")
-        xd, _ = self._shard_batch(np.asarray(x, dtype=self.feature_dtype), None)
-        preds = jax.device_get(self._state.apply_fn(self._state.params, xd))
-        return np.asarray(preds)[: len(x)]
+        x = np.asarray(x, dtype=self.feature_dtype)
+        if len(x) == 0:
+            return np.empty((0,), dtype=np.float32)
+        bs = self.batch_size
+        outs = []
+        for i in range(0, len(x), bs):
+            chunk = x[i:i + bs]
+            n = len(chunk)
+            if n < bs:
+                chunk, _ = _pad_cycle(chunk, None, bs - n)
+            xd, _ = self._shard_batch(chunk, None)
+            preds = self._predict_step(self._state, xd)
+            outs.append(np.asarray(jax.device_get(preds))[:n])
+        return np.concatenate(outs, axis=0)
+
+    def predict_on_ds(
+        self,
+        ds: MLDataset,
+        feature_columns: Optional[List[str]] = None,
+    ) -> np.ndarray:
+        """Distributed batch inference over an MLDataset: every shard
+        streams through the jitted forward on the device mesh with the
+        same double-buffered infeed as fit()/evaluate(), and rows come
+        back in dataset order. The reference has no estimator inference
+        path at all — users collect get_model() to the driver and loop
+        by hand (torch/estimator.py:315-317); here the accelerator does
+        the batching."""
+        if self._state is None:
+            raise RuntimeError("no trained state; call fit() first")
+        cols = feature_columns or self.feature_columns
+        loaders = [
+            ds.to_jax(
+                feature_columns=cols,
+                label_column=None,
+                batch_size=self.batch_size,
+                rank=rank,
+                shuffle=False,
+                feature_dtype=self.feature_dtype,
+                prefetch=2,
+                device=None,
+            )
+            for rank in range(ds.num_shards)
+        ]
+
+        def host_batches():
+            # Label-less loaders yield bare feature batches (the loader
+            # contract); _sharded_prefetch wants (x, y) pairs.
+            for loader in loaders:
+                for x in loader:
+                    yield x, None
+
+        outs = []
+        for xd, _, blen in self._sharded_prefetch(host_batches()):
+            preds = self._predict_step(self._state, xd)
+            outs.append(np.asarray(jax.device_get(preds))[: int(blen)])
+        if not outs:
+            return np.empty((0,), dtype=np.float32)
+        return np.concatenate(outs, axis=0)
+
+    def predict_on_df(
+        self,
+        df,
+        output_column: str = "prediction",
+        num_shards: int = 1,
+    ):
+        """DataFrame in, pandas DataFrame with a prediction column out
+        (the inference-side mirror of ``fit_on_df``). Alignment is
+        positional: ``from_df`` keeps partition order when not
+        shuffling, shard loaders iterate rank order, and ``to_pandas``
+        concatenates partitions in the same order. Multi-output models
+        get one row-array per cell in the output column."""
+        df = _ensure_df(df)
+        ds = MLDataset.from_df(df, num_shards=num_shards)
+        preds = np.asarray(self.predict_on_ds(ds))
+        pdf = df.to_pandas()
+        if preds.ndim > 1 and preds.shape[-1] == 1:
+            preds = preds[..., 0]
+        if preds.ndim == 1:
+            pdf[output_column] = preds
+        else:
+            pdf[output_column] = list(preds)
+        return pdf
 
     def save(
         self,
@@ -979,6 +1076,7 @@ class JAXEstimator:
         self._state = None
         self._train_step = None
         self._eval_step = None
+        self._predict_step = None
 
 
 def _pad_cycle(x, y, pad: int):

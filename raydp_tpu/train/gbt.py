@@ -395,6 +395,23 @@ class GBTEstimator:
             return 1.0 / (1.0 + np.exp(-raw))
         return raw
 
+    def predict_on_ds(self, ds) -> np.ndarray:
+        """Inference over an MLDataset's feature columns, rows in
+        dataset order (API symmetry with JAXEstimator.predict_on_ds)."""
+        cols = {}
+        for rank in range(ds.num_shards):
+            shard = ds.shard_columns(rank, list(self.feature_columns))
+            for k, v in shard.items():
+                cols.setdefault(k, []).append(np.asarray(v))
+        X = np.stack(
+            [
+                np.concatenate(cols[c]).astype(np.float32)
+                for c in self.feature_columns
+            ],
+            axis=1,
+        )
+        return self.predict(X)
+
     def evaluate(self, ds) -> dict:
         X, y = self._matrix_from_ds(ds)
         pred = self.predict(X)

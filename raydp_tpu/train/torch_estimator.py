@@ -723,6 +723,27 @@ class TorchEstimator:
             model, criterion, shard, cfg, _model_wants_columns(model)
         )
 
+    def predict(self, x) -> np.ndarray:
+        """Inference on a host feature matrix through the trained module
+        (API parity with JAXEstimator.predict — the reference exposes
+        only get_model() and leaves the loop to the user). Honors the
+        model's column-style forward the same way the train loop does."""
+        import torch
+
+        cfg = self.config
+        model = self.get_model()
+        model.eval()
+        xt = torch.from_numpy(
+            np.asarray(x).astype(cfg.get("feature_dtype") or np.float32)
+        )
+        with torch.no_grad():
+            if _model_wants_columns(model):
+                cols = [xt[:, i].unsqueeze(1) for i in range(xt.size(1))]
+                out = model(*cols)
+            else:
+                out = model(xt)
+        return out.numpy()
+
     def save(self, path: str) -> str:
         import torch
 
