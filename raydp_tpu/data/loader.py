@@ -6,23 +6,47 @@ The hot path of training ingest. Per epoch:
      where dtypes allow);
   2. a permutation is drawn (epoch-seeded — reshuffle every epoch like the
      reference's per-epoch shard shuffle, dataset.py:355-376);
-  3. batches are assembled by the native row-gather kernel
-     (raydp_tpu/native/src/gather.cpp) into reused staging buffers;
-  4. a background thread keeps ``prefetch`` staged batches ahead;
-  5. ``jax.device_put`` overlaps: batch N+1 is transferred while the
-     caller computes on batch N (double buffering — keeps the TPU from
-     stalling on HBM infeed).
+  3. transfer CHUNKS (``transfer_coalesce`` batches each) are assembled
+     by the native row-gather kernel (raydp_tpu/native/src/gather.cpp);
+  4. a background thread keeps ``prefetch`` staged chunks ahead;
+  5. chunks ship with ONE ``jax.device_put`` each and up to
+     ``transfer_window`` chunks stay in flight while the caller computes;
+     batches are on-device slices of landed chunks.
+
+Why chunks: a per-batch device_put pays the host↔device round trip per
+batch — on a remote-tunnel TPU that RTT is ~100ms, which capped r4's
+measured device feed at 0.041 GB/s while the same loader fed host arrays
+at 0.76 GB/s (r4 verdict Weak #4). Coalescing N batches into one
+transfer divides the RTT cost by N, and the multi-chunk window overlaps
+the remaining transfers with compute; on-device slicing is free by
+comparison (slices are async XLA ops that pipeline).
 """
 from __future__ import annotations
 
+import os
 import queue
 import threading
+from collections import deque
 from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
 from raydp_tpu.native import lib as native
 from raydp_tpu.utils.profiling import metrics
+
+# Auto transfer-chunk sizing: coalesce batches until a chunk reaches this
+# many bytes (or 32 batches, whichever is smaller). Sized by measurement
+# on the high-latency remote-TPU link: per-device_put overhead is
+# ~0.4s regardless of size, so effective bandwidth keeps climbing with
+# chunk size (4MB→0.007, 32MB→0.083, 128MB→0.120, 256MB→0.133 GB/s
+# measured raw); 128MB reaches ~90% of the link's asymptotic ceiling
+# while bounding staging memory at window×128MB. On a local TPU-VM PCIe
+# link the overhead is µs-scale and chunk size is immaterial — the env
+# var RAYDP_TRANSFER_CHUNK_MB overrides for tuning.
+_TARGET_CHUNK_BYTES = int(
+    __import__("os").environ.get("RAYDP_TRANSFER_CHUNK_MB", 128)
+) * 1024 * 1024
+_MAX_COALESCE = 32
 
 
 class JaxShardLoader:
@@ -45,6 +69,8 @@ class JaxShardLoader:
         prefetch: int,
         device,
         drop_last: bool,
+        transfer_coalesce: Optional[int] = None,
+        transfer_window: int = 2,
     ):
         self._dataset = dataset
         self._rank = rank
@@ -58,6 +84,11 @@ class JaxShardLoader:
         self.prefetch = max(0, prefetch)
         self.device = device
         self.drop_last = drop_last
+        # None = auto-size chunks to ~_TARGET_CHUNK_BYTES; 1 = one
+        # device_put per batch (the pre-r5 behavior, kept measurable for
+        # the bench's micro-batch row).
+        self.transfer_coalesce = transfer_coalesce
+        self.transfer_window = max(1, transfer_window)
         self._epoch = 0
         self._columns: Optional[Dict[str, np.ndarray]] = None
         self._feat_matrix: Optional[np.ndarray] = None
@@ -127,22 +158,44 @@ class JaxShardLoader:
         self._feat_matrix, self._labels = matrix, labels
         return matrix, labels
 
-    def _staged_batches(self, epoch: int) -> Iterator[Tuple[np.ndarray, Optional[np.ndarray]]]:
+    def _coalesce_batches(self) -> int:
+        """Batches per transfer chunk. Explicit setting wins; auto sizes
+        chunks toward ``_TARGET_CHUNK_BYTES`` capped at ``_MAX_COALESCE``
+        (host-path loaders — device None — stay at 1: there is no
+        transfer to amortize and per-batch granularity keeps prefetch
+        memory small)."""
+        if self.device is None:
+            return 1
+        if self.transfer_coalesce is not None:
+            return max(1, self.transfer_coalesce)
+        row_bytes = (
+            self.num_features * self.feature_dtype.itemsize
+            + (self.label_dtype.itemsize if self.label_column else 0)
+        )
+        batch_bytes = max(1, self.batch_size * row_bytes)
+        return int(
+            min(_MAX_COALESCE, max(1, _TARGET_CHUNK_BYTES // batch_bytes))
+        )
+
+    def _staged_chunks(
+        self, epoch: int, rows_per_chunk: int
+    ) -> Iterator[Tuple[np.ndarray, Optional[np.ndarray]]]:
+        """Gather the epoch's rows in ``rows_per_chunk`` pieces (a chunk
+        is ``transfer_coalesce`` batches; 1 batch on the host path)."""
         matrix, labels = self._stage_matrix()
         n = matrix.shape[0]
         order = None
         if self.shuffle:
             rng = np.random.default_rng(self.seed + epoch * 1009 + self._rank)
             order = rng.permutation(n)
-        n_batches = len(self)
+        # Rows the epoch actually serves (drop_last trims the ragged
+        # batch tail).
+        n_used = min(n, len(self) * self.batch_size)
         # Hoisted out of the hot loop: meter() takes the registry lock.
         rows_meter = metrics.meter("ingest/rows")
         bytes_meter = metrics.meter("ingest/bytes")
-        for b in range(n_batches):
-            lo = b * self.batch_size
-            hi = min(lo + self.batch_size, n)
-            if lo >= hi:
-                break
+        for lo in range(0, n_used, rows_per_chunk):
+            hi = min(lo + rows_per_chunk, n_used)
             if order is None:
                 # Sequential epoch: zero-copy row-slice views.
                 x = matrix[lo:hi]
@@ -151,7 +204,6 @@ class JaxShardLoader:
                 idx = order[lo:hi]
                 x = native.gather_rows(matrix, idx)
                 y = labels[idx] if labels is not None else None
-            metrics.counter_add("ingest/batches")
             rows_meter.add(hi - lo)
             bytes_meter.add(x.nbytes + (y.nbytes if y is not None else 0))
             yield x, y
@@ -159,30 +211,50 @@ class JaxShardLoader:
     def _epoch_iter(self, epoch: int):
         import jax
 
-        source = self._staged_batches(epoch)
+        bs = self.batch_size
+        chunk_batches = self._coalesce_batches()
+        source = self._staged_chunks(epoch, chunk_batches * bs)
         stop_event = None
         if self.prefetch > 0:
+            # prefetch counts CHUNKS: with coalescing the host-side
+            # staging holds at most prefetch × chunk bytes.
             source, stop_event = _background(source, self.prefetch)
 
         device = self.device
+        batch_counter = metrics.counter_add
 
-        def put(batch):
-            x, y = batch
+        def put_chunk(chunk):
+            x, y = chunk
             if device is not None:
                 x = jax.device_put(x, device)
                 y = jax.device_put(y, device) if y is not None else None
-            return (x, y) if self.label_column else x
+            return x, y
 
-        # Double buffer: keep one transfer in flight ahead of the consumer.
+        def batches_of(chunk):
+            x, y = chunk
+            n = x.shape[0] if hasattr(x, "shape") else len(x)
+            for lo in range(0, n, bs):
+                hi = min(lo + bs, n)
+                batch_counter("ingest/batches")
+                # On-device slicing: an async XLA slice per batch, which
+                # pipelines behind the chunk transfer instead of paying a
+                # host→device trip per batch.
+                xb = x[lo:hi]
+                yb = y[lo:hi] if y is not None else None
+                yield (xb, yb) if self.label_column else xb
+
+        # Transfer window: keep up to ``transfer_window`` chunk transfers
+        # in flight ahead of the consumer (double buffering generalized —
+        # the consumer drains batches of chunk i while chunks i+1..i+W
+        # are still shipping).
+        window: deque = deque()
         try:
-            pending = None
-            for batch in source:
-                staged = put(batch)
-                if pending is not None:
-                    yield pending
-                pending = staged
-            if pending is not None:
-                yield pending
+            for chunk in source:
+                window.append(put_chunk(chunk))
+                if len(window) > self.transfer_window:
+                    yield from batches_of(window.popleft())
+            while window:
+                yield from batches_of(window.popleft())
         finally:
             # Abandoned epoch (early break / single next()): unblock the
             # producer thread so it exits instead of leaking.

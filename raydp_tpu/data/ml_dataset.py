@@ -209,6 +209,31 @@ class MLDataset:
                 out.append(table.slice(s.offset, s.num_samples))
         return out
 
+    def shard_global_indices(self, rank: int) -> np.ndarray:
+        """Global dataset row index (block order, then row order within
+        block) of every sample in ``rank``'s plan, in plan order — the
+        inverse of the shard plan. Inference uses this to scatter
+        per-shard outputs back to dataset order: padding rows map to the
+        same global index as the row they duplicate, so a scatter
+        overwrites them with identical values and the padded sample count
+        collapses back to ``total_rows``. (Training never needs this —
+        the equal-samples padding is a lockstep invariant of the
+        reference's divide_blocks, python/raydp/utils.py:149-222, that
+        must NOT leak into inference results.)"""
+        if rank not in self.shard_plan:
+            raise IndexError(f"rank {rank} out of {self.num_shards}")
+        starts = np.zeros(len(self._block_sizes), dtype=np.int64)
+        if len(self._block_sizes) > 1:
+            starts[1:] = np.cumsum(self._block_sizes[:-1])
+        parts = [
+            starts[s.block_index] + s.offset
+            + np.arange(s.num_samples, dtype=np.int64)
+            for s in self.shard_plan[rank]
+        ]
+        if not parts:
+            return np.empty((0,), dtype=np.int64)
+        return np.concatenate(parts)
+
     def shard_columns(
         self, rank: int, columns: Optional[List[str]] = None
     ) -> Dict[str, np.ndarray]:
@@ -240,9 +265,17 @@ class MLDataset:
         prefetch: int = 2,
         device=None,
         drop_last: bool = False,
+        transfer_coalesce: Optional[int] = None,
+        transfer_window: int = 2,
     ):
         """Device-feeding batch iterator for this shard (the TPU-native
-        counterpart of ``to_torch``, reference dataset.py:411-443)."""
+        counterpart of ``to_torch``, reference dataset.py:411-443).
+
+        ``transfer_coalesce`` batches ship per ``device_put`` (None =
+        auto-size to ~32MB chunks; 1 = per-batch transfers) and up to
+        ``transfer_window`` chunk transfers stay in flight — see
+        loader.py's module docstring for why this matters on
+        high-latency device links."""
         from raydp_tpu.data.loader import JaxShardLoader
 
         return JaxShardLoader(
@@ -258,6 +291,8 @@ class MLDataset:
             prefetch=prefetch,
             device=device,
             drop_last=drop_last,
+            transfer_coalesce=transfer_coalesce,
+            transfer_window=transfer_window,
         )
 
     def to_torch(

@@ -50,6 +50,11 @@ ENV_WORLD_SIZE = "RAYDP_SPMD_WORLD_SIZE"
 ENV_DRIVER_ADDR = "RAYDP_SPMD_DRIVER_ADDR"
 ENV_COORDINATOR = "RAYDP_SPMD_COORDINATOR"
 ENV_PROCS_PER_NODE = "RAYDP_SPMD_PROCS_PER_NODE"
+# Registration-barrier tuning (driver side). The soft window resets on
+# every new rank registration; alive-but-slow workers (cold imports on a
+# busy host) are waited on up to the hard cap.
+ENV_REGISTER_TIMEOUT = "RAYDP_SPMD_REGISTER_TIMEOUT"
+ENV_REGISTER_HARD_TIMEOUT = "RAYDP_SPMD_REGISTER_HARD_TIMEOUT"
 
 
 class SPMDJobError(RuntimeError):
@@ -156,6 +161,7 @@ class SPMDJob:
         self._failed: Optional[str] = None
         self._gen = 0  # incarnation counter scoping watcher threads
         self._stopping = False
+        self._log_paths: List[str] = []
 
     def rank_nodes(self) -> List[str]:
         """Node (host) of every rank — ranks fill hosts in order,
@@ -205,6 +211,11 @@ class SPMDJob:
         if self.script_prepare_fn is not None:
             prefix = list(self.script_prepare_fn(ctx) or [])
 
+        log_dir = os.path.join(
+            "/tmp/raydp_tpu", "spmd", f"{self.job_name}-{os.getpid()}"
+        )
+        os.makedirs(log_dir, exist_ok=True)
+        self._log_paths = []
         for rank in range(self.world_size):
             env = dict(os.environ)
             env.update(ctx.env)
@@ -219,28 +230,93 @@ class SPMDJob:
                 }
             )
             cmd = prefix + [sys.executable, "-m", "raydp_tpu.spmd.worker_main"]
-            proc = subprocess.Popen(cmd, env=env)
+            # Capture each rank's output so bring-up failures can show it
+            # (the reference forwards mpirun output to the driver's stdout,
+            # reference: mpi/utils.py:68-80; files keep it available after
+            # the fact too, per SURVEY §5.5 per-process log files).
+            log_path = os.path.join(log_dir, f"rank-{rank}.log")
+            self._log_paths.append(log_path)
+            with open(log_path, "ab") as logf:
+                proc = subprocess.Popen(
+                    cmd, env=env, stdout=logf, stderr=subprocess.STDOUT
+                )
             self._procs.append(proc)
             threading.Thread(
                 target=self._watch_proc, args=(proc, rank, gen), daemon=True
             ).start()
 
-        if not self._register_barrier.wait(self.timeout):
-            got = len(self._worker_addrs)
-            self.stop()
-            raise SPMDJobError(
-                f"job {self.job_name}: only {got}/{self.world_size} ranks "
-                f"registered within {self.timeout}s"
-            )
+        self._await_registration()
         if self._failed:
             # A rank crashed during bring-up; the barrier was released by
             # _fail so this raises immediately, not after the timeout.
             self.stop()
-            raise SPMDJobError(f"job {self.job_name} failed: {self._failed}")
+            raise SPMDJobError(
+                f"job {self.job_name} failed: {self._failed}"
+                + self._log_tails()
+            )
         for rank, addr in self._worker_addrs.items():
             self._stubs[rank] = RpcClient(addr, WORKER_SERVICE, timeout=None)
         self._started = True
         return self
+
+    def _await_registration(self) -> None:
+        """Progress-aware registration barrier. A fixed wall timeout fails
+        spuriously when cold worker imports contend for CPU (two parallel
+        cold JAX/grpc imports on a busy one-core host can take minutes),
+        so: the soft window (``timeout``, env ``RAYDP_SPMD_REGISTER_
+        TIMEOUT``) resets whenever a new rank registers, and workers that
+        are still *alive* are waited on past it up to the hard cap (env
+        ``RAYDP_SPMD_REGISTER_HARD_TIMEOUT``, default ``max(10×soft,
+        300)``s). Dead-without-registering ranks fail fast via the
+        process watcher. Failure messages carry each rank's log tail."""
+        soft = float(
+            os.environ.get(ENV_REGISTER_TIMEOUT) or self.timeout
+        )
+        hard = float(
+            os.environ.get(ENV_REGISTER_HARD_TIMEOUT)
+            or max(10.0 * soft, 300.0)
+        )
+        start_t = time.monotonic()
+        deadline = start_t + soft
+        seen = 0
+        while not self._register_barrier.wait(1.0):
+            now = time.monotonic()
+            got = len(self._worker_addrs)
+            if got > seen:
+                seen = got
+                deadline = now + soft  # progress resets the soft window
+                continue
+            if now < deadline:
+                continue
+            alive = all(p.poll() is None for p in self._procs)
+            if alive and now < start_t + hard:
+                continue  # slow but alive: cold imports on a loaded host
+            tails = self._log_tails()
+            self.stop()
+            raise SPMDJobError(
+                f"job {self.job_name}: only {got}/{self.world_size} ranks "
+                f"registered within {now - start_t:.0f}s "
+                f"(soft={soft:.0f}s hard={hard:.0f}s, "
+                f"workers alive={alive})" + tails
+            )
+
+    def _log_tails(self, limit: int = 2000) -> str:
+        """Last ``limit`` bytes of every rank's captured output, formatted
+        for inclusion in an error message ('' when nothing captured)."""
+        parts = []
+        for rank, path in enumerate(getattr(self, "_log_paths", [])):
+            try:
+                with open(path, "rb") as f:
+                    f.seek(0, os.SEEK_END)
+                    f.seek(max(0, f.tell() - limit))
+                    text = f.read().decode("utf-8", "replace").strip()
+            except OSError:
+                continue
+            if text:
+                parts.append(f"--- rank {rank} ({path}) ---\n{text}")
+        if not parts:
+            return ""
+        return "\nworker logs:\n" + "\n".join(parts)
 
     def _pick_coordinator_port(self) -> int:
         """jax.distributed coordinator port. Probing only proves a port is

@@ -397,7 +397,12 @@ class GBTEstimator:
 
     def predict_on_ds(self, ds) -> np.ndarray:
         """Inference over an MLDataset's feature columns, rows in
-        dataset order (API symmetry with JAXEstimator.predict_on_ds)."""
+        dataset order with exactly ``ds.total_rows`` results (API
+        symmetry with JAXEstimator.predict_on_ds). Shard plans pad each
+        rank to ``ceil(total/num_shards)`` rows for SPMD lockstep; the
+        padded per-shard predictions are scattered back through
+        ``ds.shard_global_indices`` so padding duplicates collapse onto
+        the rows they duplicate."""
         cols = {}
         for rank in range(ds.num_shards):
             shard = ds.shard_columns(rank, list(self.feature_columns))
@@ -410,7 +415,13 @@ class GBTEstimator:
             ],
             axis=1,
         )
-        return self.predict(X)
+        flat = self.predict(X)
+        idx = np.concatenate(
+            [ds.shard_global_indices(r) for r in range(ds.num_shards)]
+        )
+        out = np.empty((ds.total_rows,) + flat.shape[1:], dtype=flat.dtype)
+        out[idx] = flat
+        return out
 
     def evaluate(self, ds) -> dict:
         X, y = self._matrix_from_ds(ds)

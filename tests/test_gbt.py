@@ -190,3 +190,26 @@ def test_gbt_num_epochs_zero_trains_nothing():
     # Prediction falls back to the base score for every row.
     pred = est.predict(pdf[["a", "b", "c"]].to_numpy())
     assert np.allclose(pred, est._base_score)
+
+
+def test_gbt_predict_on_ds_non_divisible_rows():
+    """100 rows over 3 shards pads each shard to 34 rows; predict_on_ds
+    must still return exactly 100 predictions in dataset order (the
+    shard-padding duplication bug class caught in JAXEstimator)."""
+    pdf = _reg_frame(n=100)
+    est = GBTEstimator(
+        n_trees=10,
+        max_depth=3,
+        feature_columns=["a", "b", "c"],
+        label_column="y",
+    )
+    est.fit_on_df(rdf.from_pandas(pdf, num_partitions=4))
+    ds = MLDataset.from_df(
+        rdf.from_pandas(pdf, num_partitions=3), num_shards=3
+    )
+    preds = est.predict_on_ds(ds)
+    assert preds.shape == (100,)
+    direct = est.predict(
+        pdf[["a", "b", "c"]].to_numpy(dtype=np.float32)
+    )
+    np.testing.assert_allclose(preds, direct, rtol=1e-5)

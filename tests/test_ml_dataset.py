@@ -169,3 +169,45 @@ def test_abandoned_epoch_no_thread_leak():
     time.sleep(0.5)
     after = threading.active_count()
     assert after <= before + 1, f"leaked threads: {before} -> {after}"
+
+
+def test_shard_global_indices_invert_padding():
+    """The scatter-inverse of the shard plan: indices cover every row at
+    least once, every rank's index list matches its sample count, and a
+    scatter of identity values reconstructs dataset order exactly
+    (padding duplicates overwrite with identical values)."""
+    for n, shards in [(100, 3), (1001, 5), (64, 4)]:
+        ds = MLDataset.from_df(_df(n, max(shards, 4)), num_shards=shards)
+        all_idx = np.concatenate(
+            [ds.shard_global_indices(r) for r in range(shards)]
+        )
+        # Each rank's indices match its (padded) plan sample count.
+        for r in range(shards):
+            assert len(ds.shard_global_indices(r)) == sum(
+                s.num_samples for s in ds.shard_plan[r]
+            )
+        # Full coverage: every global row appears somewhere.
+        assert set(all_idx.tolist()) == set(range(n))
+        # Scatter reconstructs dataset order.
+        out = np.full(n, -1, dtype=np.int64)
+        out[all_idx] = all_idx
+        np.testing.assert_array_equal(out, np.arange(n))
+
+
+def test_shard_global_indices_match_shard_rows():
+    """Indices point at the same rows shard_tables serves: gathering the
+    source column by the global indices equals the shard's materialized
+    column, for both the plain and locality-aware plans."""
+    n = 137
+    rng = np.random.default_rng(5)
+    vals = rng.standard_normal(n)
+    df = rdf.from_pandas(
+        pd.DataFrame({"a": vals, "label": vals}), num_partitions=4
+    )
+    for rank_nodes in [None, ["node-0", "node-1", "node-0"]]:
+        ds = MLDataset.from_df(df, num_shards=3, rank_nodes=rank_nodes)
+        for r in range(3):
+            got = ds.shard_columns(r, ["a"])["a"]
+            np.testing.assert_allclose(
+                got, vals[ds.shard_global_indices(r)], rtol=1e-6
+            )

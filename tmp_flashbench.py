@@ -1,0 +1,59 @@
+"""Scratch microbench: flash vs dense attention fwd+bwd on the chip.
+
+Usage: python tmp_flashbench.py [seq ...]
+Not part of the package; deleted before round end.
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raydp_tpu.ops.attention import reference_attention
+from raydp_tpu.ops.flash_attention import flash_attention
+
+SEQS = [int(s) for s in sys.argv[1:]] or [2048, 8192]
+TOKENS = 16384  # constant token budget -> batch = TOKENS // seq
+H, D = 8, 64
+DTYPE = jnp.bfloat16
+
+
+def bench(fn, args, iters=20):
+    out = jax.block_until_ready(fn(*args))  # compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = jax.block_until_ready(fn(*args))
+    return (time.perf_counter() - t0) / iters
+
+
+def make(seq):
+    b = max(1, TOKENS // seq)
+    rng = np.random.default_rng(0)
+    shape = (b, seq, H, D)
+    q = jnp.asarray(rng.standard_normal(shape), DTYPE)
+    k = jnp.asarray(rng.standard_normal(shape), DTYPE)
+    v = jnp.asarray(rng.standard_normal(shape), DTYPE)
+    return q, k, v
+
+
+def loss_of(attn, **kw):
+    def f(q, k, v):
+        return jnp.sum(attn(q, k, v, causal=True, **kw).astype(jnp.float32))
+    return jax.jit(jax.grad(f, argnums=(0, 1, 2)))
+
+
+for seq in SEQS:
+    q, k, v = make(seq)
+    b = q.shape[0]
+    row = {"seq": seq, "batch": b}
+    for name, fn in [
+        ("dense", loss_of(reference_attention)),
+        ("flash", loss_of(flash_attention)),
+    ]:
+        try:
+            dt = bench(fn, (q, k, v))
+            row[name] = f"{dt*1e3:.2f}ms {b*seq/dt/1e3:.0f}ktok/s"
+        except Exception as e:  # noqa: BLE001
+            row[name] = f"FAIL {type(e).__name__}: {str(e)[:80]}"
+    print(row, flush=True)

@@ -1,0 +1,62 @@
+"""Scratch: replicate bench_longcontext's full-model measurement.
+
+Usage: python tmp_modelbench.py [seq ...]
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from raydp_tpu.models.transformer import CausalLM, TransformerConfig
+
+SEQS = [int(s) for s in sys.argv[1:]] or [2048]
+
+for seq in SEQS:
+    batch = max(1, 8192 // seq)
+    for impl in ("dense", "flash"):
+        cfg = TransformerConfig(
+            vocab_size=8192, n_layers=4, n_heads=8, d_model=512,
+            d_ff=2048, max_len=seq, causal=True, dropout_rate=0.0,
+            attention_impl=impl, dtype=jnp.bfloat16,
+        )
+        model = CausalLM(cfg=cfg)
+        rs = np.random.RandomState(0)
+        ids = jnp.asarray(rs.randint(0, cfg.vocab_size, size=(batch, seq)))
+
+        def loss_fn(p, ids):
+            logits = model.apply(p, ids)
+            tgt = jnp.roll(ids, -1, axis=1)
+            ll = jax.nn.log_softmax(logits.astype(jnp.float32))
+            return -jnp.mean(jnp.take_along_axis(ll, tgt[..., None], axis=-1))
+
+        try:
+            params = model.init(jax.random.PRNGKey(0), ids)
+            opt = optax.adamw(1e-4)
+            opt_state = opt.init(params)
+
+            @jax.jit
+            def step(p, s, ids):
+                loss, g = jax.value_and_grad(loss_fn)(p, ids)
+                up, s = opt.update(g, s, p)
+                return optax.apply_updates(p, up), s, loss
+
+            params, opt_state, _ = jax.block_until_ready(
+                step(params, opt_state, ids))  # compile
+            n = 8
+            t0 = time.perf_counter()
+            for _ in range(n):
+                params, opt_state, loss = step(params, opt_state, ids)
+            jax.block_until_ready(loss)
+            dt = time.perf_counter() - t0
+            print({"seq": seq, "impl": impl, "batch": batch,
+                   "tokens_per_sec": round(n * batch * seq / dt),
+                   "step_ms": round(dt / n * 1e3, 2)}, flush=True)
+        except Exception as e:  # noqa: BLE001
+            print({"seq": seq, "impl": impl,
+                   "error": f"{type(e).__name__}: {str(e)[:100]}"}, flush=True)
+        params = opt_state = None
+        import gc
+        gc.collect()
