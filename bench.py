@@ -844,6 +844,18 @@ def bench_ingest():
 
 # ----------------------------------------------------------- ETL shuffle
 
+def _cluster_aggregate(session, wait_s: float = 6.0):
+    """Pull the heartbeat-merged cluster aggregate, polling briefly: the
+    timed loop just saturated the host, so the workers' last deltas may
+    still be a beat (2s) away from the master."""
+    deadline = time.monotonic() + wait_s
+    while True:
+        agg = session.cluster.metrics_snapshot().get("aggregate")
+        if agg or time.monotonic() >= deadline:
+            return agg
+        time.sleep(0.5)
+
+
 def bench_etl_groupby():
     """Distributed groupBy/agg throughput on the multi-process cluster
     (ETL is the reference's core business; the shuffle rides the native
@@ -881,6 +893,9 @@ def bench_etl_groupby():
             dt = min(dt, time.perf_counter() - t0)
         assert len(out) == pdf["k"].nunique()
         ours = n_rows / dt
+        # Per-worker view merged from heartbeat-shipped deltas: shows how
+        # evenly the shuffle spread over the 4 workers.
+        cluster_agg = _cluster_aggregate(session)
     finally:
         raydp_tpu.stop()
 
@@ -897,6 +912,7 @@ def bench_etl_groupby():
         "unit": "rows/s",
         "vs_baseline": round(ours / base, 3),
         "host_cpus": os.cpu_count(),
+        "cluster_telemetry": cluster_agg,
         "baseline": "single-process pandas groupby.agg (in-memory)",
     }
 
@@ -1027,6 +1043,7 @@ def bench_dlrm_criteo_scale():
             epoch_mode="stream",
         )
         ours = _steady(est.fit(ds))
+        cluster_agg = _cluster_aggregate(session)
     finally:
         raydp_tpu.stop()
     return {
@@ -1036,7 +1053,77 @@ def bench_dlrm_criteo_scale():
         "tables": n_tables,
         "etl_seconds": round(etl_s, 2),
         "vs_baseline": None,
+        "cluster_telemetry": cluster_agg,
         "baseline": "none (scale config; dlrm_criteo carries the torch baseline)",
+    }
+
+
+def bench_attention_kernels():
+    """Raw attention-OP microbench: flash vs dense fwd+bwd at a constant
+    token budget (batch = TOKENS // seq), H=8 D=64. The kernel-level
+    view underneath bench_longcontext's full-model numbers — isolates
+    the attention impl from embedding/FFN/optimizer work, so a flash
+    regression shows here even when the model bench hides it behind
+    GEMM time."""
+    import jax
+    import jax.numpy as jnp
+
+    from raydp_tpu.ops.attention import reference_attention
+    from raydp_tpu.ops.flash_attention import flash_attention
+
+    tokens, heads, head_dim = 16384, 8, 64
+    seqs = [512, 1024] if _CPU_FALLBACK else [2048, 8192]
+    # f32 on CPU for the same reason as the model benches; bf16 is the
+    # MXU-native dtype on chip.
+    dtype = jnp.float32 if _CPU_FALLBACK else jnp.bfloat16
+    iters = 4 if _CPU_FALLBACK else 20
+
+    def loss_of(attn):
+        def f(q, k, v):
+            return jnp.sum(attn(q, k, v, causal=True).astype(jnp.float32))
+
+        return jax.jit(jax.grad(f, argnums=(0, 1, 2)))
+
+    results = {}
+    for seq in seqs:
+        if _over_deadline(margin=60.0):
+            results[seq] = {"skipped": "bench deadline"}
+            continue
+        batch = max(1, tokens // seq)
+        rng = np.random.default_rng(0)
+        shape = (batch, seq, heads, head_dim)
+        q = jnp.asarray(rng.standard_normal(shape), dtype)
+        k = jnp.asarray(rng.standard_normal(shape), dtype)
+        v = jnp.asarray(rng.standard_normal(shape), dtype)
+        per_seq = {"batch": batch}
+        for name, fn in (
+            ("dense", loss_of(reference_attention)),
+            ("flash", loss_of(flash_attention)),
+        ):
+            try:
+                # Bracket with a host fetch, not block_until_ready (see
+                # _timed_train_steps: the tunnel platform returns from
+                # block_until_ready before the computation runs).
+                grads = fn(q, k, v)  # compile + warmup
+                float(jnp.sum(grads[0].astype(jnp.float32)))
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    grads = fn(q, k, v)
+                float(jnp.sum(grads[0].astype(jnp.float32)))
+                dt = (time.perf_counter() - t0) / iters
+                per_seq[name] = {
+                    "step_ms": round(dt * 1e3, 2),
+                    "tokens_per_sec": round(batch * seq / dt, 1),
+                }
+            except Exception as exc:  # OOM and friends: record, continue
+                per_seq[name] = f"{type(exc).__name__}: {str(exc)[:80]}"
+        results[seq] = per_seq
+    return {
+        "fwd_bwd_by_seq": results,
+        "unit": "tokens/s",
+        "heads": heads,
+        "head_dim": head_dim,
+        "token_budget": tokens,
     }
 
 
@@ -1154,6 +1241,7 @@ def bench_etl_window():
             dt = min(dt, time.perf_counter() - t0)
         assert len(out) == n_rows
         ours = n_rows / dt
+        cluster_agg = _cluster_aggregate(session)
     finally:
         raydp_tpu.stop()
 
@@ -1171,6 +1259,7 @@ def bench_etl_window():
         "unit": "rows/s",
         "vs_baseline": round(ours / base, 3),
         "host_cpus": os.cpu_count(),
+        "cluster_telemetry": cluster_agg,
         "baseline": "single-process pandas sort+groupby cumulative ops",
     }
 
@@ -1194,6 +1283,7 @@ CPU_MATRIX = [
     ("dlrm_embedding_study", bench_dlrm_embedding_study),
     ("dlrm_criteo_scale", bench_dlrm_criteo_scale),
     ("longcontext_seq_scaling", bench_longcontext),
+    ("attention_kernels", bench_attention_kernels),
 ]
 
 # The chip matrix runs in a CHILD process at full sizes. The ETL
@@ -1212,6 +1302,7 @@ CHIP_MATRIX_NAMES = [
     "dlrm_criteo",
     "bert_glue",
     "longcontext_seq_scaling",
+    "attention_kernels",
     "dlrm_embedding_study",
     "dlrm_criteo_scale",
 ]
@@ -1307,13 +1398,22 @@ def _on_signal(signum, frame):
 
 
 def _run_and_stamp(fn) -> dict:
-    """Run one bench fn: errors become a result, wall time is stamped."""
+    """Run one bench fn: errors become a result, wall time is stamped,
+    and the process metrics registry (reset per config) is attached —
+    the ingest meters / step-timer percentiles behind each number ride
+    along in the emitted JSON."""
+    from raydp_tpu.utils.profiling import metrics
+
+    metrics.reset()  # per-config telemetry, not cumulative across configs
     t0 = time.perf_counter()
     try:
         res = fn()
     except Exception as exc:  # record, keep benching
         res = {"error": f"{type(exc).__name__}: {exc}"}
     res["seconds"] = round(time.perf_counter() - t0, 1)
+    snap = metrics.snapshot()
+    if snap.get("counters") or len(snap) > 1:
+        res["telemetry"] = snap
     import gc
 
     gc.collect()

@@ -125,6 +125,9 @@ class _RemoteMaster:
     def cluster_resources(self) -> dict:
         return self._client.call("ClusterResources", {})
 
+    def metrics_snapshot(self) -> dict:
+        return self._client.call("MetricsSnapshot", {})["snapshot"]
+
     def mark_worker_dead(self, worker_id: str, reason: str = "") -> None:
         # Best-effort: the real master's own monitors are authoritative;
         # a client merely stops routing to the worker.
@@ -176,6 +179,18 @@ class RemoteCluster:
 
     def cluster_resources(self) -> dict:
         return self.master.cluster_resources()
+
+    def metrics_snapshot(self) -> dict:
+        """The remote master's merged telemetry view (its ``driver`` entry
+        is the cluster-owning process, not this client)."""
+        return self.master.metrics_snapshot()
+
+    def prometheus_metrics(self) -> str:
+        """Render the remote view locally — the exposition text never
+        crosses the wire, only the pickled snapshot does."""
+        from raydp_tpu.telemetry import render_prometheus
+
+        return render_prometheus(self.metrics_snapshot())
 
     # -- task submission ------------------------------------------------
     def submit(self, fn, *args, worker_id=None, timeout=300.0, **kwargs):

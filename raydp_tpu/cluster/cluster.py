@@ -312,6 +312,7 @@ class Cluster:
         else:
             for worker_id in worker_ids:
                 self._stop_worker(worker_id, kill_objects=False)
+            self._flush_telemetry()
         self._pool.shutdown(wait=False)
         if self.master is not None:
             if del_obj_holder:
@@ -320,6 +321,22 @@ class Cluster:
         # objects on remote nodes must remain fetchable until
         # release_holder() (reference: stop_spark(del_obj_holder=False),
         # context.py:208-215).
+
+    def _flush_telemetry(self) -> None:
+        """Persist lifecycle events + driver spans to JSONL on graceful
+        shutdown (no-op unless RAYDP_TPU_TELEMETRY_DIR is set). Workers
+        have already stopped, so their final WorkerStopped snapshots are
+        merged into the master's telemetry view by now."""
+        from raydp_tpu.telemetry import flush_spans, telemetry_dir, write_events
+
+        if telemetry_dir() is None:
+            return
+        try:
+            if self.master is not None:
+                write_events(self.master.telemetry.events())
+            flush_spans()
+        except Exception:  # pragma: no cover - telemetry must not block exit
+            logger.exception("telemetry flush failed")
 
     def release_holder(self) -> None:
         """Unlink holder-owned objects, stop agents + the master service."""
@@ -419,6 +436,27 @@ class Cluster:
 
     def cluster_resources(self) -> dict:
         return self.master.cluster_resources()
+
+    def metrics_snapshot(self) -> dict:
+        """Merged cluster-wide metrics: per-worker views (heartbeat-shipped
+        deltas, tombstoned final snapshots for dead workers), a cross-worker
+        aggregate, lifecycle events, and the driver's own registry."""
+        if self.master is not None:
+            return self.master.metrics_snapshot()
+        from raydp_tpu.utils.profiling import metrics as _m
+
+        return {
+            "workers": {},
+            "aggregate": {},
+            "events": [],
+            "driver": _m.snapshot(),
+        }
+
+    def prometheus_metrics(self) -> str:
+        """The merged view as Prometheus text exposition v0.0.4."""
+        from raydp_tpu.telemetry import render_prometheus
+
+        return render_prometheus(self.metrics_snapshot())
 
     # -- task submission --------------------------------------------------
     def submit(

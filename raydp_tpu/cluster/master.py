@@ -24,6 +24,8 @@ from typing import Dict, List, Optional
 from raydp_tpu.cluster import placement as pl
 from raydp_tpu.cluster.rpc import RpcServer
 from raydp_tpu.store.agent import agent_handlers
+from raydp_tpu.telemetry import ClusterTelemetry
+from raydp_tpu.telemetry import spans as _spans
 from raydp_tpu.store.directory import DirectoryStore
 from raydp_tpu.store.object_store import DEFAULT_NODE, OWNER_HOLDER, ObjectRef
 
@@ -72,6 +74,9 @@ class AppMaster:
         self._agent_event = threading.Event()
         self._expected_agent_nodes: set = set()
         self._monitor_stop = threading.Event()
+        # Cluster-wide metrics view: workers ship registry deltas on
+        # their heartbeats; this merges them keyed by worker id.
+        self.telemetry = ClusterTelemetry()
         handlers = {
             "RegisterWorker": self._on_register_worker,
             "Heartbeat": self._on_heartbeat,
@@ -85,6 +90,7 @@ class AppMaster:
             "DeleteObject": self._on_delete_object,
             "ListWorkers": self._on_list_workers,
             "ClusterResources": self._on_cluster_resources,
+            "MetricsSnapshot": self._on_metrics_snapshot,
             "Ping": lambda req: {"pong": True, "namespace": self.namespace},
         }
         # The master doubles as the driver node's store agent (no extra
@@ -146,6 +152,13 @@ class AppMaster:
             if info is None or info.state != "ALIVE":
                 return
             info.state = "DEAD"
+        # Tombstone, don't drop: the final shipped snapshot is exactly
+        # what a straggler post-mortem needs.
+        self.telemetry.tombstone(worker_id)
+        self.telemetry.event("worker/dead", worker_id=worker_id,
+                             reason=reason)
+        _spans.event("cluster/worker_dead", worker_id=worker_id,
+                     reason=reason)
         doomed = self.store.on_owner_died(worker_id)
         logger.warning(
             "worker %s dead (%s); unlinked %d objects",
@@ -175,6 +188,10 @@ class AppMaster:
         with self._lock:
             self._workers[info.worker_id] = info
             self._check_registration_barrier()
+        self.telemetry.event("worker/registered", worker_id=info.worker_id,
+                             node_id=info.node_id, pid=info.pid)
+        _spans.event("cluster/worker_registered", worker_id=info.worker_id,
+                     node_id=info.node_id)
         logger.info("registered worker %s @ %s", info.worker_id, info.address)
         return {"namespace": self.namespace}
 
@@ -184,6 +201,12 @@ class AppMaster:
             self._registration_event.set()
 
     def _on_heartbeat(self, req: dict) -> dict:
+        # Piggybacked metrics delta — merged even for workers this
+        # master has written off (their last beats still carry data),
+        # and outside the worker-table lock (telemetry has its own).
+        delta = req.get("metrics")
+        if delta:
+            self.telemetry.apply(req["worker_id"], delta)
         with self._lock:
             info = self._workers.get(req["worker_id"])
             if info is None:
@@ -193,6 +216,11 @@ class AppMaster:
 
     def _on_worker_stopped(self, req: dict) -> dict:
         worker_id = req["worker_id"]
+        # Graceful exit ships the FULL final snapshot; merge + tombstone
+        # so the worker's lifetime totals outlive it.
+        self.telemetry.apply(worker_id, req.get("metrics"), final=True)
+        self.telemetry.event("worker/stopped", worker_id=worker_id)
+        _spans.event("cluster/worker_stopped", worker_id=worker_id)
         with self._lock:
             info = self._workers.get(worker_id)
             if info is not None:
@@ -275,6 +303,19 @@ class AppMaster:
 
     def _on_cluster_resources(self, req: dict) -> dict:
         return self.cluster_resources()
+
+    def _on_metrics_snapshot(self, req: dict) -> dict:
+        return {"snapshot": self.metrics_snapshot()}
+
+    def metrics_snapshot(self) -> dict:
+        """Merged cluster metrics: per-worker views (tombstones
+        included), the cross-worker aggregate, lifecycle events, and
+        this (driver) process's own registry under ``"driver"``."""
+        from raydp_tpu.utils.profiling import metrics as _m
+
+        view = self.telemetry.merged()
+        view["driver"] = _m.snapshot()
+        return view
 
     def cluster_resources(self) -> dict:
         """Resource introspection (reference:

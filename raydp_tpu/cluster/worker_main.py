@@ -25,6 +25,8 @@ import cloudpickle
 
 from raydp_tpu.cluster.rpc import RpcClient, RpcServer
 from raydp_tpu.store.object_store import ObjectStore
+from raydp_tpu.telemetry import MetricsShipper
+from raydp_tpu.utils.profiling import metrics
 
 logger = logging.getLogger(__name__)
 
@@ -101,6 +103,9 @@ class Worker:
         # the in-flight RunTask on the driver side.
         self._busy = 0
         self._busy_lock = threading.Lock()
+        # Telemetry: each heartbeat carries the registry sections that
+        # changed since the previous beat (delta-encoded snapshot).
+        self._shipper = MetricsShipper()
         # The RPC server is up before registration completes, and the master
         # lists this worker ALIVE the moment RegisterWorker returns — so a
         # task can arrive while ctx is still being built. Gate on readiness.
@@ -167,7 +172,9 @@ class Worker:
             fn = cloudpickle.loads(req["fn"])
             args = req.get("args", ())
             kwargs = req.get("kwargs", {})
-            result = fn(self.ctx, *args, **kwargs)
+            metrics.counter_add("worker/tasks")
+            with metrics.timer("worker/task").time():
+                result = fn(self.ctx, *args, **kwargs)
             return {"result": result}
         except Exception:
             # Let RpcServer._wrap serialize the failure uniformly.
@@ -187,12 +194,17 @@ class Worker:
         self.register()
         missed = 0
         while not self._stop_event.wait(2.0):
-            reply = self.master.try_call(
-                "Heartbeat", {"worker_id": self.worker_id}, timeout=8.0
-            )
+            beat = {"worker_id": self.worker_id}
+            delta = self._shipper.delta()
+            if delta:
+                beat["metrics"] = delta
+            reply = self.master.try_call("Heartbeat", beat, timeout=8.0)
             with self._busy_lock:
                 busy = self._busy > 0
             if reply is None:
+                # Failed beats must not eat their metrics delta: re-ship
+                # the sections on the next beat.
+                self._shipper.rollback(delta)
                 # Transient master hiccups — including a driver process
                 # saturated by a big shuffle on a small host — are
                 # absorbed; only a sustained outage means exit. And never
@@ -237,8 +249,12 @@ class Worker:
                 logger.warning("worker %s: master disowned us; exiting",
                                self.worker_id)
                 break
+        # Final snapshot, not a delta: a clean exit must leave the master's
+        # tombstoned view complete even if the last few deltas were lost.
         self.master.try_call(
-            "WorkerStopped", {"worker_id": self.worker_id}, timeout=2.0
+            "WorkerStopped",
+            {"worker_id": self.worker_id, "metrics": self._shipper.full()},
+            timeout=2.0,
         )
         self._server.stop()
 

@@ -32,6 +32,7 @@ from typing import Dict, Iterator, List, Optional, Tuple
 import numpy as np
 
 from raydp_tpu.native import lib as native
+from raydp_tpu.telemetry import span
 from raydp_tpu.utils.profiling import metrics
 
 # Auto transfer-chunk sizing: coalesce batches until a chunk reaches this
@@ -135,17 +136,20 @@ class JaxShardLoader:
         cols = self._materialize()
         feats = [cols[c] for c in self.feature_columns]
         n = len(feats[0])
-        if self.feature_dtype in (np.dtype(np.float32), np.dtype(np.int32)):
-            # Sequential pass through the native kernel.
-            matrix = native.gather_matrix(
-                feats, np.arange(n, dtype=np.int64),
-                out_dtype=self.feature_dtype,
-            )
-        else:
-            matrix = np.stack(
-                [f.astype(self.feature_dtype, copy=False) for f in feats],
-                axis=1,
-            )
+        with span("ingest/stage_matrix", rank=self._rank, rows=n,
+                  features=len(feats)):
+            if self.feature_dtype in (np.dtype(np.float32),
+                                      np.dtype(np.int32)):
+                # Sequential pass through the native kernel.
+                matrix = native.gather_matrix(
+                    feats, np.arange(n, dtype=np.int64),
+                    out_dtype=self.feature_dtype,
+                )
+            else:
+                matrix = np.stack(
+                    [f.astype(self.feature_dtype, copy=False) for f in feats],
+                    axis=1,
+                )
         labels = None
         if self.label_column:
             labels = cols[self.label_column].astype(
@@ -196,16 +200,23 @@ class JaxShardLoader:
         bytes_meter = metrics.meter("ingest/bytes")
         for lo in range(0, n_used, rows_per_chunk):
             hi = min(lo + rows_per_chunk, n_used)
-            if order is None:
-                # Sequential epoch: zero-copy row-slice views.
-                x = matrix[lo:hi]
-                y = labels[lo:hi] if labels is not None else None
-            else:
-                idx = order[lo:hi]
-                x = native.gather_rows(matrix, idx)
-                y = labels[idx] if labels is not None else None
-            rows_meter.add(hi - lo)
-            bytes_meter.add(x.nbytes + (y.nbytes if y is not None else 0))
+            # The span closes before the yield: a suspended generator must
+            # not hold an open span on this thread's stack while consumer
+            # code (steps, other chunks) runs and parents under it.
+            with span("ingest/chunk", epoch=epoch, rank=self._rank,
+                      rows=hi - lo):
+                if order is None:
+                    # Sequential epoch: zero-copy row-slice views.
+                    x = matrix[lo:hi]
+                    y = labels[lo:hi] if labels is not None else None
+                else:
+                    idx = order[lo:hi]
+                    x = native.gather_rows(matrix, idx)
+                    y = labels[idx] if labels is not None else None
+                rows_meter.add(hi - lo)
+                bytes_meter.add(
+                    x.nbytes + (y.nbytes if y is not None else 0)
+                )
             yield x, y
 
     def _epoch_iter(self, epoch: int):

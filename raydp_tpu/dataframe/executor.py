@@ -25,8 +25,18 @@ from typing import Any, Callable, List, Optional, Sequence
 import pyarrow as pa
 
 from raydp_tpu.store.object_store import ObjectRef, ObjectStore
+from raydp_tpu.telemetry import span
+from raydp_tpu.utils.profiling import metrics
 
 StageFn = Callable[[pa.Table], pa.Table]
+
+
+def _stage_span(op: str, n_parts: int, executor: str):
+    """Span + counter around one stage execution (driver side: covers
+    submit AND result gather on the cluster backend, so the duration is
+    the stage's wall time as the query planner experiences it)."""
+    metrics.counter_add("df/stages")
+    return span("df/stage", op=op, parts=n_parts, executor=executor)
 
 # Memoized gather-concat for coalesced runs (Spark's analog: shuffle
 # block reuse). Interactive ETL re-runs queries over the SAME stored
@@ -195,31 +205,36 @@ class LocalExecutor(Executor):
         )
 
     def map_partitions(self, parts, fn):
-        return list(self._pool.map(fn, parts))
+        with _stage_span("map_partitions", len(parts), "local"):
+            return list(self._pool.map(fn, parts))
 
     def map_partitions_indexed(self, parts, fn):
-        return list(self._pool.map(fn, parts, range(len(parts))))
+        with _stage_span("map_partitions_indexed", len(parts), "local"):
+            return list(self._pool.map(fn, parts, range(len(parts))))
 
     def map_pairs(self, parts_a, parts_b, fn):
-        return list(self._pool.map(fn, parts_a, parts_b))
+        with _stage_span("map_pairs", len(parts_a), "local"):
+            return list(self._pool.map(fn, parts_a, parts_b))
 
     def exchange(self, parts, splitter, n_out, combine=None):
-        chunked = list(self._pool.map(splitter, parts))
-        outs = []
-        for i in range(n_out):
-            merged = _concat([chunks[i] for chunks in chunked])
-            outs.append(combine(merged) if combine else merged)
-        return outs
+        with _stage_span("exchange", len(parts), "local"):
+            chunked = list(self._pool.map(splitter, parts))
+            outs = []
+            for i in range(n_out):
+                merged = _concat([chunks[i] for chunks in chunked])
+                outs.append(combine(merged) if combine else merged)
+            return outs
 
     def part_nbytes(self, part):
         return part.nbytes
 
     def run_coalesced(self, parts, fn, pre_concat=False):
-        if not pre_concat:
-            return fn(list(parts))
         parts = list(parts)
-        key = ("local",) + tuple(id(t) for t in parts)
-        return fn(_concat_cached(parts, key, keepalive=parts))
+        with _stage_span("run_coalesced", len(parts), "local"):
+            if not pre_concat:
+                return fn(parts)
+            key = ("local",) + tuple(id(t) for t in parts)
+            return fn(_concat_cached(parts, key, keepalive=parts))
 
     def materialize(self, part):
         return part
@@ -278,25 +293,27 @@ class ClusterExecutor(Executor):
             table = ctx.get_table(ref)
             return ctx.put_table(fn(table), holder=True)
 
-        futures = [
-            self.cluster.submit_async(
-                task, ref, worker_id=self._worker_for(i, ref)
-            )
-            for i, ref in enumerate(parts)
-        ]
-        return [f.result() for f in futures]
+        with _stage_span("map_partitions", len(parts), "cluster"):
+            futures = [
+                self.cluster.submit_async(
+                    task, ref, worker_id=self._worker_for(i, ref)
+                )
+                for i, ref in enumerate(parts)
+            ]
+            return [f.result() for f in futures]
 
     def map_partitions_indexed(self, parts, fn):
         def task(ctx, ref, index):
             table = ctx.get_table(ref)
             return ctx.put_table(fn(table, index), holder=True)
 
-        futures = [
-            self.cluster.submit_async(task, ref, i,
-                                      worker_id=self._worker_for(i, ref))
-            for i, ref in enumerate(parts)
-        ]
-        return [f.result() for f in futures]
+        with _stage_span("map_partitions_indexed", len(parts), "cluster"):
+            futures = [
+                self.cluster.submit_async(task, ref, i,
+                                          worker_id=self._worker_for(i, ref))
+                for i, ref in enumerate(parts)
+            ]
+            return [f.result() for f in futures]
 
     def part_nbytes(self, part):
         return part.size if isinstance(part, ObjectRef) else part.nbytes
@@ -337,9 +354,11 @@ class ClusterExecutor(Executor):
             )
             if workers:
                 worker_id = workers[0]
-        return self.cluster.submit_async(
-            task, list(parts), worker_id=worker_id
-        ).result()
+        parts = list(parts)
+        with _stage_span("run_coalesced", len(parts), "cluster"):
+            return self.cluster.submit_async(
+                task, parts, worker_id=worker_id
+            ).result()
 
     def map_pairs(self, parts_a, parts_b, fn):
         def task(ctx, ra, rb):
@@ -347,25 +366,19 @@ class ClusterExecutor(Executor):
             tb = ctx.get_table(rb)
             return ctx.put_table(fn(ta, tb), holder=True)
 
-        futures = [
-            self.cluster.submit_async(
-                task, ra, rb, worker_id=self._worker_for(i, ra)
-            )
-            for i, (ra, rb) in enumerate(zip(parts_a, parts_b))
-        ]
-        return [f.result() for f in futures]
+        with _stage_span("map_pairs", len(parts_a), "cluster"):
+            futures = [
+                self.cluster.submit_async(
+                    task, ra, rb, worker_id=self._worker_for(i, ra)
+                )
+                for i, (ra, rb) in enumerate(zip(parts_a, parts_b))
+            ]
+            return [f.result() for f in futures]
 
     def exchange(self, parts, splitter, n_out, combine=None):
         def split_task(ctx, ref):
             table = ctx.get_table(ref)
             return [ctx.put_table(chunk, holder=True) for chunk in splitter(table)]
-
-        futures = [
-            self.cluster.submit_async(split_task, ref,
-                                      worker_id=self._worker_for(i, ref))
-            for i, ref in enumerate(parts)
-        ]
-        chunk_refs = [f.result() for f in futures]  # [n_in][n_out]
 
         def merge_task(ctx, refs):
             tables = [ctx.get_table(r) for r in refs]
@@ -374,20 +387,27 @@ class ClusterExecutor(Executor):
                 merged = combine(merged)
             return ctx.put_table(merged, holder=True)
 
-        merge_futures = [
-            self.cluster.submit_async(
-                merge_task,
-                [chunks[i] for chunks in chunk_refs],
-                worker_id=self._worker_for(i),
-            )
-            for i in range(n_out)
-        ]
-        outs = [f.result() for f in merge_futures]
-        # Intermediate chunks are dead weight now.
-        for chunks in chunk_refs:
-            for ref in chunks:
-                self.store.delete(ref)
-        return outs
+        with _stage_span("exchange", len(parts), "cluster"):
+            futures = [
+                self.cluster.submit_async(split_task, ref,
+                                          worker_id=self._worker_for(i, ref))
+                for i, ref in enumerate(parts)
+            ]
+            chunk_refs = [f.result() for f in futures]  # [n_in][n_out]
+            merge_futures = [
+                self.cluster.submit_async(
+                    merge_task,
+                    [chunks[i] for chunks in chunk_refs],
+                    worker_id=self._worker_for(i),
+                )
+                for i in range(n_out)
+            ]
+            outs = [f.result() for f in merge_futures]
+            # Intermediate chunks are dead weight now.
+            for chunks in chunk_refs:
+                for ref in chunks:
+                    self.store.delete(ref)
+            return outs
 
     def materialize(self, part):
         return self.cluster.resolver.get_arrow_table(part)

@@ -34,6 +34,7 @@ from typing import Any, Callable, Dict, List, Optional
 import cloudpickle
 
 from raydp_tpu.cluster.rpc import RpcClient, RpcServer
+from raydp_tpu.telemetry import ClusterTelemetry, span
 from raydp_tpu.utils.net import find_free_port
 
 logger = logging.getLogger(__name__)
@@ -162,6 +163,9 @@ class SPMDJob:
         self._gen = 0  # incarnation counter scoping watcher threads
         self._stopping = False
         self._log_paths: List[str] = []
+        # Per-rank metrics merged from heartbeat-shipped deltas; survives
+        # gang restarts (ranks keep their keys across incarnations).
+        self.telemetry = ClusterTelemetry()
 
     def rank_nodes(self) -> List[str]:
         """Node (host) of every rank — ranks fill hosts in order,
@@ -196,7 +200,7 @@ class SPMDJob:
                 "RegisterWorker": self._on_register_worker,
                 "FuncResult": self._on_func_result,
                 "JobFailed": self._on_job_failed,
-                "Ping": lambda req: {"pong": True, "gen": self._gen},
+                "Ping": self._on_ping,
             },
             host=bind_host,
         )
@@ -366,6 +370,16 @@ class SPMDJob:
         self._fail(req.get("reason", "worker-reported failure"))
         return {}
 
+    def _on_ping(self, req: dict) -> dict:
+        delta = req.get("metrics")
+        if delta:
+            self.telemetry.apply(f"rank-{req.get('rank', '?')}", delta)
+        return {"pong": True, "gen": self._gen}
+
+    def metrics_snapshot(self) -> dict:
+        """Merged per-rank metrics view (heartbeat-shipped deltas)."""
+        return self.telemetry.merged()
+
     # -------------------------------------------------------------------- run
 
     def run(
@@ -391,41 +405,46 @@ class SPMDJob:
             )
         with self._lock:
             self._func_id += 1
-            results = _FuncResults(self._func_id, self.world_size)
-            self._inflight = results
-            fn_blob = cloudpickle.dumps(fn)
-            for rank, stub in self._stubs.items():
-                payload = {"func_id": self._func_id, "fn": fn_blob}
-                # Deadline sized to the payload (fn closure + scatter blob)
-                # at a worst-case ~10 MB/s over DCN, on top of the control
-                # default — NOT the whole-job timeout, which would let the
-                # serial send loop hide failures for world×timeout.
-                nbytes = len(fn_blob)
-                if per_rank_args is not None:
-                    blob = cloudpickle.dumps(tuple(per_rank_args[rank]))
-                    payload["args"] = blob
-                    nbytes += len(blob)
-                stub.call(
-                    "RunFunction", payload, timeout=10.0 + nbytes / 10e6
-                )
-            if not results.done.wait(timeout or max(self.timeout, 60.0)):
-                raise SPMDJobError(
-                    f"function {self._func_id} timed out on job {self.job_name}"
-                )
-            self._inflight = None
-            if self._failed:
-                raise SPMDJobError(
-                    f"job {self.job_name} failed mid-function: {self._failed}"
-                )
-            errors = [
-                f"rank {i}: {e}" for i, e in enumerate(results.errors) if e
-            ]
-            if errors:
-                raise SPMDJobError(
-                    f"function failed on {len(errors)} rank(s):\n"
-                    + "\n".join(errors)
-                )
-            return results.results
+            with span("spmd/dispatch", job=self.job_name,
+                      func_id=self._func_id, world_size=self.world_size):
+                results = _FuncResults(self._func_id, self.world_size)
+                self._inflight = results
+                fn_blob = cloudpickle.dumps(fn)
+                for rank, stub in self._stubs.items():
+                    payload = {"func_id": self._func_id, "fn": fn_blob}
+                    # Deadline sized to the payload (fn closure + scatter
+                    # blob) at a worst-case ~10 MB/s over DCN, on top of
+                    # the control default — NOT the whole-job timeout,
+                    # which would let the serial send loop hide failures
+                    # for world×timeout.
+                    nbytes = len(fn_blob)
+                    if per_rank_args is not None:
+                        blob = cloudpickle.dumps(tuple(per_rank_args[rank]))
+                        payload["args"] = blob
+                        nbytes += len(blob)
+                    stub.call(
+                        "RunFunction", payload, timeout=10.0 + nbytes / 10e6
+                    )
+                if not results.done.wait(timeout or max(self.timeout, 60.0)):
+                    raise SPMDJobError(
+                        f"function {self._func_id} timed out on job "
+                        f"{self.job_name}"
+                    )
+                self._inflight = None
+                if self._failed:
+                    raise SPMDJobError(
+                        f"job {self.job_name} failed mid-function: "
+                        f"{self._failed}"
+                    )
+                errors = [
+                    f"rank {i}: {e}" for i, e in enumerate(results.errors) if e
+                ]
+                if errors:
+                    raise SPMDJobError(
+                        f"function failed on {len(errors)} rank(s):\n"
+                        + "\n".join(errors)
+                    )
+                return results.results
 
     def get_rank_addresses(self) -> List[str]:
         """Host of each rank, rank-ordered (reference: mpi_job.py:337-339)."""

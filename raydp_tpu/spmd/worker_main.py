@@ -33,6 +33,7 @@ from raydp_tpu.spmd.job import (
     ENV_WORLD_SIZE,
     WORKER_SERVICE,
 )
+from raydp_tpu.telemetry import MetricsShipper
 from raydp_tpu.utils.net import local_ip
 
 logger = logging.getLogger(__name__)
@@ -154,10 +155,21 @@ class SPMDWorker:
     def _heartbeat(self) -> None:
         """Detect a dead driver while idle — without this, a SIGKILLed
         driver would orphan the whole gang (and the chips it holds)
-        forever; result-posting only notices mid-function."""
+        forever; result-posting only notices mid-function.
+
+        Each beat also ships the registry sections that changed since the
+        previous one (delta-encoded ``metrics.snapshot()``), so the driver's
+        ``SPMDJob.metrics_snapshot()`` sees per-rank step timers and
+        throughput without a second RPC channel."""
+        shipper = MetricsShipper()
         missed = 0
         while not self._stop_event.wait(5.0):
-            if self.driver.try_call("Ping", {}, timeout=5.0) is None:
+            beat = {"rank": self.rank}
+            delta = shipper.delta()
+            if delta:
+                beat["metrics"] = delta
+            if self.driver.try_call("Ping", beat, timeout=5.0) is None:
+                shipper.rollback(delta)  # re-ship the delta next beat
                 missed += 1
                 if missed >= 3:
                     logger.warning(

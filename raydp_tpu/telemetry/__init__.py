@@ -1,0 +1,45 @@
+"""Cluster-wide telemetry plane.
+
+Three layers, all stdlib-only (importable from worker entry points
+without pulling in jax):
+
+* :mod:`~raydp_tpu.telemetry.spans` — structured spans with parent
+  links and an in-process ring buffer, wired into the framework's hot
+  paths (loader chunk staging, estimator epochs/steps, SPMD dispatch,
+  DataFrame stages, master worker lifecycle).
+* :mod:`~raydp_tpu.telemetry.shipping` — delta-encoded
+  ``metrics.snapshot()`` payloads piggybacked on existing heartbeat
+  RPCs; the master merges them into a per-worker cluster view that
+  survives worker death (tombstoned final snapshots).
+* :mod:`~raydp_tpu.telemetry.export` — the merged view as Prometheus
+  text exposition v0.0.4, plus append-only JSONL span/event logs under
+  ``RAYDP_TPU_TELEMETRY_DIR``.
+
+Drivers pull the live aggregate with ``Cluster.metrics_snapshot()``
+(works identically through ``raydp_tpu.connect`` client sessions).
+See ``doc/telemetry.md``.
+"""
+from raydp_tpu.telemetry.export import (
+    TELEMETRY_DIR_ENV,
+    flush_spans,
+    render_prometheus,
+    telemetry_dir,
+    write_events,
+)
+from raydp_tpu.telemetry.shipping import ClusterTelemetry, MetricsShipper
+from raydp_tpu.telemetry.spans import Span, SpanRecorder, event, recorder, span
+
+__all__ = [
+    "Span",
+    "SpanRecorder",
+    "recorder",
+    "span",
+    "event",
+    "MetricsShipper",
+    "ClusterTelemetry",
+    "TELEMETRY_DIR_ENV",
+    "telemetry_dir",
+    "flush_spans",
+    "write_events",
+    "render_prometheus",
+]

@@ -1,0 +1,212 @@
+"""Export surface: Prometheus text exposition + append-only JSONL logs.
+
+Two consumers, two formats:
+
+* **Prometheus text exposition v0.0.4** — :func:`render_prometheus`
+  turns a merged cluster view (``Cluster.metrics_snapshot()``) into
+  scrape-ready text. Registry names become label values (not metric
+  names), so arbitrary ``ingest/rows``-style names need no mangling and
+  the metric families stay fixed:
+
+  - ``raydp_worker_up{worker=…}`` gauge (0 = tombstoned)
+  - ``raydp_counter_total{worker=…,name=…}`` counter
+  - ``raydp_meter_units_total`` / ``raydp_meter_units_per_second``
+  - ``raydp_timer_seconds`` summary (quantile samples + ``_sum``/``_count``)
+
+* **JSONL logs** — :func:`flush_spans` drains the process span ring to
+  ``<telemetry_dir>/spans.jsonl``; :func:`write_events` appends master
+  lifecycle events to ``events.jsonl``. One JSON object per line,
+  append-only, safe to tail while the job runs.
+
+``telemetry_dir`` is configured with the ``RAYDP_TPU_TELEMETRY_DIR``
+environment variable (inherited by worker subprocesses, so every
+process of a job logs under one directory) or passed explicitly.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Dict, Iterable, List, Optional
+
+from raydp_tpu.telemetry import spans as _spans
+
+__all__ = [
+    "TELEMETRY_DIR_ENV",
+    "telemetry_dir",
+    "append_jsonl",
+    "flush_spans",
+    "write_events",
+    "render_prometheus",
+]
+
+TELEMETRY_DIR_ENV = "RAYDP_TPU_TELEMETRY_DIR"
+
+_write_mu = threading.Lock()
+
+
+def telemetry_dir() -> Optional[str]:
+    """The configured telemetry directory, or None when disabled."""
+    return os.environ.get(TELEMETRY_DIR_ENV) or None
+
+
+def append_jsonl(path: str, records: Iterable[Dict[str, Any]]) -> int:
+    """Append records as JSON lines; returns the number written.
+    Non-JSON-safe attr values are stringified rather than dropped."""
+    count = 0
+    with _write_mu:
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(path, "a", encoding="utf-8") as f:
+            for rec in records:
+                f.write(json.dumps(rec, default=str) + "\n")
+                count += 1
+    return count
+
+
+def flush_spans(
+    directory: Optional[str] = None, recorder: Optional[Any] = None
+) -> Optional[str]:
+    """Drain the span ring buffer to ``<dir>/spans.jsonl``.
+
+    No-op (buffer left intact) when no directory is configured, so
+    instrumented code calls this unconditionally. Returns the log path
+    when writing happened.
+    """
+    directory = directory or telemetry_dir()
+    if not directory:
+        return None
+    rec = recorder if recorder is not None else _spans.recorder
+    drained = rec.drain()
+    path = os.path.join(directory, "spans.jsonl")
+    append_jsonl(path, (s.to_dict() for s in drained))
+    return path
+
+
+def write_events(
+    events: List[Dict[str, Any]], directory: Optional[str] = None
+) -> Optional[str]:
+    """Append lifecycle events to ``<dir>/events.jsonl``."""
+    directory = directory or telemetry_dir()
+    if not directory or not events:
+        return None
+    path = os.path.join(directory, "events.jsonl")
+    append_jsonl(path, events)
+    return path
+
+
+# -- Prometheus text exposition v0.0.4 ---------------------------------
+
+
+def _fmt(value: float) -> str:
+    try:
+        value = float(value)
+    except (TypeError, ValueError):
+        return "NaN"
+    if value == int(value) and abs(value) < 1e15:
+        return str(int(value))
+    return repr(value)
+
+
+def _label(value: str) -> str:
+    """Escape a label value per the exposition format."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+class _Family:
+    def __init__(self, name: str, kind: str, help_text: str):
+        self.name = name
+        self.kind = kind
+        self.help = help_text
+        self.samples: List[str] = []
+
+    def add(self, labels: Dict[str, str], value: float,
+            suffix: str = "") -> None:
+        inner = ",".join(
+            f'{k}="{_label(v)}"' for k, v in sorted(labels.items())
+        )
+        self.samples.append(f"{self.name}{suffix}{{{inner}}} {_fmt(value)}")
+
+    def render(self) -> List[str]:
+        if not self.samples:
+            return []
+        return [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} {self.kind}",
+            *self.samples,
+        ]
+
+
+def render_prometheus(view: Dict[str, Any]) -> str:
+    """Merged cluster view → Prometheus text exposition v0.0.4.
+
+    ``view`` is the ``Cluster.metrics_snapshot()`` shape: ``{"workers":
+    {wid: {...sections...}}, "aggregate": ..., "driver": ...}``. The
+    driver's own registry renders under ``worker="driver"``; the
+    aggregate is intentionally NOT rendered (Prometheus aggregates at
+    query time — pre-aggregated series would double-count on ``sum()``).
+    """
+    up = _Family(
+        "raydp_worker_up", "gauge",
+        "Worker liveness (0 = dead; final snapshot tombstoned).",
+    )
+    counters = _Family(
+        "raydp_counter_total", "counter",
+        "MetricsRegistry counters, one series per (worker, name).",
+    )
+    meter_total = _Family(
+        "raydp_meter_units_total", "counter",
+        "ThroughputMeter cumulative units (rows, bytes, samples).",
+    )
+    meter_rate = _Family(
+        "raydp_meter_units_per_second", "gauge",
+        "ThroughputMeter rate since first record.",
+    )
+    timers = _Family(
+        "raydp_timer_seconds", "summary",
+        "StepTimer rolling-window summaries.",
+    )
+
+    sources: Dict[str, Dict[str, Any]] = dict(view.get("workers") or {})
+    driver = view.get("driver")
+    if driver:
+        sources["driver"] = driver
+
+    for worker_id in sorted(sources):
+        sections = sources[worker_id]
+        if worker_id != "driver":
+            up.add(
+                {"worker": worker_id},
+                0.0 if sections.get("tombstone") else 1.0,
+            )
+        for key in sorted(sections):
+            section = sections[key]
+            if key in ("tombstone", "updated_wall"):
+                continue
+            if key == "counters":
+                for name in sorted(section):
+                    counters.add(
+                        {"worker": worker_id, "name": name}, section[name]
+                    )
+            elif key.startswith("meter/"):
+                labels = {"worker": worker_id, "name": key[len("meter/"):]}
+                meter_total.add(labels, section.get("total", 0.0))
+                meter_rate.add(labels, section.get("per_sec", 0.0))
+            elif key.startswith("timer/"):
+                labels = {"worker": worker_id, "name": key[len("timer/"):]}
+                for q, stat in (("0.5", "p50_s"), ("0.9", "p90_s"),
+                                ("0.99", "p99_s")):
+                    timers.add(
+                        {**labels, "quantile": q}, section.get(stat, 0.0)
+                    )
+                timers.add(labels, section.get("total_s", 0.0), suffix="_sum")
+                timers.add(labels, section.get("count", 0.0), suffix="_count")
+
+    lines: List[str] = []
+    for family in (up, counters, meter_total, meter_rate, timers):
+        lines.extend(family.render())
+    return "\n".join(lines) + ("\n" if lines else "")

@@ -1,0 +1,192 @@
+"""Structured spans: timed, nested regions of framework work.
+
+The tracing half of the telemetry plane (the metrics half lives in
+:mod:`raydp_tpu.utils.profiling` and ships via heartbeats — see
+:mod:`raydp_tpu.telemetry.shipping`). A :class:`Span` records one unit
+of work with ids, a parent link, and both wall-clock and monotonic
+timestamps; finished spans land in an in-process ring buffer that
+:func:`raydp_tpu.telemetry.export.flush_spans` drains to an append-only
+JSONL log.
+
+Parent links come from a per-thread stack: a span started while another
+span is open on the same thread becomes its child (estimator step spans
+nest under the epoch span). Spans recorded on other threads — the
+loader's prefetch producer, RPC handler threads — start fresh traces;
+cross-thread parenting is deliberately out of scope (no context
+propagation machinery on the hot path).
+
+Hot-path cost: one ``perf_counter`` pair, a dict, and a locked deque
+append per span. Instrumented paths put spans at chunk/step/stage
+granularity, never per row.
+"""
+from __future__ import annotations
+
+import contextlib
+import itertools
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional
+
+__all__ = ["Span", "SpanRecorder", "recorder", "span", "event"]
+
+# Ring capacity: big enough to hold a full small training run's spans,
+# bounded so an unflushed long job cannot grow without limit.
+_CAPACITY = int(os.environ.get("RAYDP_TPU_SPAN_BUFFER", "4096"))
+
+
+@dataclass
+class Span:
+    """One timed region. ``end_mono`` is None while the span is open."""
+
+    name: str
+    span_id: str
+    trace_id: str
+    parent_id: Optional[str]
+    seq: int  # process-wide start order (monotonic, gap-free per process)
+    start_wall: float  # time.time() at start — for cross-process alignment
+    start_mono: float  # perf_counter at start — for exact durations
+    attrs: Dict[str, Any] = field(default_factory=dict)
+    end_mono: Optional[float] = None
+    status: str = "ok"  # ok | error
+    kind: str = "span"  # span | event (zero-duration point annotation)
+
+    @property
+    def duration_s(self) -> Optional[float]:
+        if self.end_mono is None:
+            return None
+        return self.end_mono - self.start_mono
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "span_id": self.span_id,
+            "trace_id": self.trace_id,
+            "parent_id": self.parent_id,
+            "seq": self.seq,
+            "start_wall": self.start_wall,
+            "start_mono": self.start_mono,
+            "duration_s": self.duration_s,
+            "status": self.status,
+            "kind": self.kind,
+            "attrs": self.attrs,
+            "pid": os.getpid(),
+        }
+
+
+class SpanRecorder:
+    """Per-process span factory + bounded ring buffer of finished spans."""
+
+    def __init__(self, capacity: int = _CAPACITY):
+        self._buf: "deque[Span]" = deque(maxlen=capacity)
+        self._mu = threading.Lock()
+        self._tls = threading.local()
+        self._seq = itertools.count(1)
+
+    # -- id scheme ------------------------------------------------------
+    def _next_id(self, seq: int) -> str:
+        # pid-qualified so logs from several processes appended to one
+        # JSONL file never collide.
+        return f"{os.getpid():x}-{seq:x}"
+
+    def _stack(self) -> List[Span]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = []
+            self._tls.stack = st
+        return st
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self, name: str, **attrs: Any) -> Span:
+        """Open a span; the current thread's innermost open span (if any)
+        becomes its parent. Pair with :meth:`finish`."""
+        stack = self._stack()
+        parent = stack[-1] if stack else None
+        seq = next(self._seq)
+        span_id = self._next_id(seq)
+        sp = Span(
+            name=name,
+            span_id=span_id,
+            trace_id=parent.trace_id if parent else span_id,
+            parent_id=parent.span_id if parent else None,
+            seq=seq,
+            start_wall=time.time(),
+            start_mono=time.perf_counter(),
+            attrs=attrs,
+        )
+        stack.append(sp)
+        return sp
+
+    def finish(self, sp: Span) -> None:
+        if sp.end_mono is not None:
+            return
+        sp.end_mono = time.perf_counter()
+        stack = self._stack()
+        # Remove exactly this span (identity match): an out-of-order
+        # finish must not orphan unrelated siblings above it.
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is sp:
+                del stack[i]
+                break
+        with self._mu:
+            self._buf.append(sp)
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs: Any) -> Iterator[Span]:
+        sp = self.start(name, **attrs)
+        try:
+            yield sp
+        except BaseException:
+            sp.status = "error"
+            raise
+        finally:
+            self.finish(sp)
+
+    def event(self, name: str, **attrs: Any) -> Span:
+        """Zero-duration point annotation (worker registered, worker
+        dead, …), parented like a span."""
+        stack = self._stack()
+        parent = stack[-1] if stack else None
+        seq = next(self._seq)
+        span_id = self._next_id(seq)
+        now = time.perf_counter()
+        sp = Span(
+            name=name,
+            span_id=span_id,
+            trace_id=parent.trace_id if parent else span_id,
+            parent_id=parent.span_id if parent else None,
+            seq=seq,
+            start_wall=time.time(),
+            start_mono=now,
+            attrs=attrs,
+            end_mono=now,
+            kind="event",
+        )
+        with self._mu:
+            self._buf.append(sp)
+        return sp
+
+    # -- buffer access --------------------------------------------------
+    def drain(self) -> List[Span]:
+        """Remove and return all finished spans (oldest first)."""
+        with self._mu:
+            out = list(self._buf)
+            self._buf.clear()
+        return out
+
+    def spans(self) -> List[Span]:
+        """Finished spans without clearing (tests, dashboards)."""
+        with self._mu:
+            return list(self._buf)
+
+    def clear(self) -> None:
+        with self._mu:
+            self._buf.clear()
+
+
+#: Process-wide recorder — the instrumented hot paths all record here.
+recorder = SpanRecorder()
+span = recorder.span
+event = recorder.event

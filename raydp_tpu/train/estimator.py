@@ -26,6 +26,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from raydp_tpu.data.ml_dataset import MLDataset
 from raydp_tpu.parallel.mesh import MeshSpec
+from raydp_tpu.telemetry import flush_spans, span
 from raydp_tpu.train.losses import resolve_loss, resolve_metric
 
 logger = logging.getLogger(__name__)
@@ -461,6 +462,9 @@ class JAXEstimator:
             cb.on_epoch_end(epoch, metrics)
         if self.checkpoint_dir:
             self.save(self.checkpoint_dir, step=epoch)
+        # Epoch boundary = natural flush point for the span ring buffer
+        # (no-op unless RAYDP_TPU_TELEMETRY_DIR is configured).
+        flush_spans()
         return metrics
 
     # -- training -------------------------------------------------------
@@ -550,54 +554,71 @@ class JAXEstimator:
                             continue
                         yield x, y
 
-            for xd, yd, blen in self._sharded_prefetch(host_batches()):
-                rng, step_rng = jax.random.split(rng)
-                while True:
-                    try:
-                        self._state, loss_val = self._train_step(
-                            self._state, xd, yd, step_rng
-                        )
-                        break
-                    except Exception:
-                        # Step-level retry budget
-                        # (TrainConfig.max_failures; reference: Ray
-                        # Train max_retries, torch/estimator.py:269).
-                        # Transient device/runtime errors re-run the
-                        # same batch; persistent ones exhaust the
-                        # budget and surface.
-                        if self.donate_state:
-                            # The failed dispatch consumed the donated
-                            # state buffers — a retry cannot succeed.
-                            # Surface the ORIGINAL error instead of
-                            # burning the budget on "Buffer donated".
-                            raise
-                        failures += 1
-                        if failures > self.max_failures:
-                            raise
-                        logger.warning(
-                            "train step failed (%d/%d); retrying batch",
-                            failures, self.max_failures, exc_info=True,
-                        )
-                loss_sum = loss_val if loss_sum is None else loss_sum + loss_val
-                n_batches += 1
-                b_idx += 1
-                steps_done += 1
-                n_samples += blen
-                if (
-                    self.save_every_steps
-                    and self.checkpoint_dir
-                    and steps_done % self.save_every_steps == 0
-                ):
-                    self.save(
-                        self.checkpoint_dir,
-                        step=f"mid_{steps_done}",
-                        data_position=(epoch, b_idx),
+            from raydp_tpu.utils.profiling import metrics as _m
+
+            step_timer = _m.timer("train/step")
+            # The epoch span covers only the batch loop (it closes before
+            # _finish_epoch so a flush there sees it finished); step spans
+            # nest under it via the thread-local stack. Step timing here is
+            # DISPATCH time (async jax: the device may still be computing)
+            # — steady-state it converges to true step time because the
+            # pipeline is throughput-bound, and compile steps stand out.
+            with span("train/epoch", epoch=epoch, mode="stream"):
+                for xd, yd, blen in self._sharded_prefetch(host_batches()):
+                    rng, step_rng = jax.random.split(rng)
+                    with span("train/step", epoch=epoch, step=b_idx) as sp:
+                        while True:
+                            try:
+                                self._state, loss_val = self._train_step(
+                                    self._state, xd, yd, step_rng
+                                )
+                                break
+                            except Exception:
+                                # Step-level retry budget
+                                # (TrainConfig.max_failures; reference: Ray
+                                # Train max_retries, torch/estimator.py:269).
+                                # Transient device/runtime errors re-run the
+                                # same batch; persistent ones exhaust the
+                                # budget and surface.
+                                if self.donate_state:
+                                    # The failed dispatch consumed the
+                                    # donated state buffers — a retry cannot
+                                    # succeed. Surface the ORIGINAL error
+                                    # instead of burning the budget on
+                                    # "Buffer donated".
+                                    raise
+                                failures += 1
+                                if failures > self.max_failures:
+                                    raise
+                                logger.warning(
+                                    "train step failed (%d/%d); retrying "
+                                    "batch",
+                                    failures, self.max_failures,
+                                    exc_info=True,
+                                )
+                    step_timer.observe(sp.duration_s)
+                    loss_sum = (
+                        loss_val if loss_sum is None else loss_sum + loss_val
                     )
-                if self.log_every and n_batches % self.log_every == 0:
-                    logger.info(
-                        "epoch %d step %d loss %.5f",
-                        epoch, n_batches, float(loss_val),  # sync: opt-in
-                    )
+                    n_batches += 1
+                    b_idx += 1
+                    steps_done += 1
+                    n_samples += blen
+                    if (
+                        self.save_every_steps
+                        and self.checkpoint_dir
+                        and steps_done % self.save_every_steps == 0
+                    ):
+                        self.save(
+                            self.checkpoint_dir,
+                            step=f"mid_{steps_done}",
+                            data_position=(epoch, b_idx),
+                        )
+                    if self.log_every and n_batches % self.log_every == 0:
+                        logger.info(
+                            "epoch %d step %d loss %.5f",
+                            epoch, n_batches, float(loss_val),  # sync: opt-in
+                        )
             train_loss = float(loss_sum) / max(1, n_batches) if (
                 loss_sum is not None
             ) else 0.0
@@ -761,28 +782,31 @@ class JAXEstimator:
         for epoch in range(epochs):
             t0 = time.perf_counter()
             rng, key = jax.random.split(rng)
-            while True:
-                try:
-                    self._state, mean_loss = epoch_fn(
-                        self._state, xd, yd, key
-                    )
-                    break
-                except Exception:
-                    # Scan mode fuses the epoch into one dispatch, so the
-                    # retry granularity is the EPOCH — same budget, same
-                    # donation rule as the stream path: a donated state
-                    # was consumed by the failed dispatch, retrying it
-                    # can only mask the original error.
-                    if self.donate_state:
-                        raise
-                    failures += 1
-                    if failures > self.max_failures:
-                        raise
-                    logger.warning(
-                        "scan epoch %d failed (%d/%d); retrying epoch",
-                        epoch, failures, self.max_failures, exc_info=True,
-                    )
-            train_loss = float(mean_loss)  # one sync per epoch
+            with span("train/epoch", epoch=epoch, mode="scan",
+                      n_steps=n_steps):
+                while True:
+                    try:
+                        self._state, mean_loss = epoch_fn(
+                            self._state, xd, yd, key
+                        )
+                        break
+                    except Exception:
+                        # Scan mode fuses the epoch into one dispatch, so
+                        # the retry granularity is the EPOCH — same budget,
+                        # same donation rule as the stream path: a donated
+                        # state was consumed by the failed dispatch,
+                        # retrying it can only mask the original error.
+                        if self.donate_state:
+                            raise
+                        failures += 1
+                        if failures > self.max_failures:
+                            raise
+                        logger.warning(
+                            "scan epoch %d failed (%d/%d); retrying epoch",
+                            epoch, failures, self.max_failures,
+                            exc_info=True,
+                        )
+                train_loss = float(mean_loss)  # one sync per epoch
             # True-sample throughput: padded duplicate rows don't count.
             metrics = self._finish_epoch(
                 epoch, t0, train_loss, n_true, evaluate_ds

@@ -90,31 +90,46 @@ class StepTimer:
 
 
 class ThroughputMeter:
-    """Counts units (rows, bytes) against wall time since first record."""
+    """Counts units (rows, bytes) against wall time since first record.
+
+    Locked like StepTimer: ``add()`` runs on training/ingest threads
+    while ``summary()`` runs on the heartbeat thread shipping snapshots
+    — an unlocked ``_units += units`` read-modify-write would drop
+    updates under that concurrency, and ``rate()`` could pair a fresh
+    ``_units`` with a stale ``_last``. One uncontended lock per CHUNK
+    (callers meter per chunk/batch, not per row) is noise."""
 
     def __init__(self):
         self._units = 0.0
         self._start: Optional[float] = None
         self._last: Optional[float] = None
+        self._mu = threading.Lock()
 
     def add(self, units: float) -> None:
         now = time.perf_counter()
-        if self._start is None:
-            self._start = now
-        self._last = now
-        self._units += units
+        with self._mu:
+            if self._start is None:
+                self._start = now
+            self._last = now
+            self._units += units
 
     @property
     def total(self) -> float:
-        return self._units
+        with self._mu:
+            return self._units
 
     def rate(self) -> float:
+        with self._mu:
+            return self._rate_locked()
+
+    def _rate_locked(self) -> float:
         if self._start is None or self._last is None or self._last <= self._start:
             return 0.0
         return self._units / (self._last - self._start)
 
     def summary(self) -> Dict[str, float]:
-        return {"total": self._units, "per_sec": self.rate()}
+        with self._mu:
+            return {"total": self._units, "per_sec": self._rate_locked()}
 
 
 @dataclass

@@ -95,6 +95,40 @@ def test_cancelled_rpc_is_retried_on_another_worker():
         raydp_tpu.stop()
 
 
+def test_shipped_metrics_survive_worker_death():
+    """Metrics that arrived over heartbeats must outlive the worker: a
+    write-off tombstones the telemetry view but keeps the last-shipped
+    data, so a straggler that died mid-run still shows in the
+    post-mortem aggregate (raydp_tpu.telemetry.shipping)."""
+    s = _session(n=1)
+    try:
+        wid = s.cluster.alive_workers()[0].worker_id
+
+        def record(ctx):
+            from raydp_tpu.utils.profiling import metrics
+            metrics.counter_add("hb/test", 42)
+            return "ok"
+
+        assert s.cluster.submit(record, worker_id=wid, timeout=30.0) == "ok"
+        # Worker heartbeats every 2s; wait for the delta to land.
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            view = s.cluster.metrics_snapshot()
+            if "counters" in view["workers"].get(wid, {}):
+                break
+            time.sleep(0.5)
+        assert view["workers"][wid]["counters"]["hb/test"] == 42
+
+        s.cluster.master.mark_worker_dead(wid, reason="test kill")
+        view = s.cluster.metrics_snapshot()
+        dead = view["workers"][wid]
+        assert dead["tombstone"] is True
+        assert dead["counters"]["hb/test"] == 42  # data retained
+        assert view["aggregate"]["counters"]["hb/test"] == 42
+    finally:
+        raydp_tpu.stop()
+
+
 def test_monitor_grants_grace_after_its_own_stall():
     """A monitor tick that overslept (driver GIL-starved) must hand the
     oversleep back as heartbeat grace instead of declaring a massacre:

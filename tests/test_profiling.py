@@ -37,6 +37,30 @@ def test_throughput_meter():
     assert m.rate() > 0
 
 
+def test_throughput_meter_concurrent_adds():
+    """Regression: pre-telemetry ThroughputMeter did ``self.total += n``
+    unlocked, so concurrent ingest threads (loader prefetch + consumer)
+    lost increments. 8 threads × 10k adds must land exactly."""
+    import threading
+
+    m = ThroughputMeter()
+    n_threads, n_adds = 8, 10_000
+
+    def hammer():
+        for _ in range(n_adds):
+            m.add(1)
+
+    threads = [threading.Thread(target=hammer) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert m.total == n_threads * n_adds
+    s = m.summary()
+    assert s["total"] == n_threads * n_adds
+    assert s["per_sec"] > 0
+
+
 def test_registry_snapshot_and_reset():
     r = MetricsRegistry()
     r.counter_add("a", 2)
