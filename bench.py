@@ -44,6 +44,7 @@ import os
 import signal
 import subprocess
 import sys
+import tempfile
 import threading
 import time
 
@@ -1600,12 +1601,58 @@ def _run_chip_phase(budget_s: float) -> None:
     _CHILD = None
 
 
+def _parse_trace_out(argv):
+    """``--trace-out [PATH]`` → merged Chrome trace destination (default
+    next to the results JSON). Consumes the flag from argv; ensures a
+    telemetry dir exists so spans have somewhere to shard — deliberately
+    via os.environ, so cluster/SPMD child processes inherit it and their
+    shards land in the same merge."""
+    if "--trace-out" not in argv:
+        return None
+    idx = argv.index("--trace-out")
+    path = None
+    if idx + 1 < len(argv) and not argv[idx + 1].startswith("--"):
+        path = argv[idx + 1]
+        del argv[idx:idx + 2]
+    else:
+        del argv[idx]
+    if path is None:
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_trace.json"
+        )
+    from raydp_tpu.telemetry import TELEMETRY_DIR_ENV
+
+    if not os.environ.get(TELEMETRY_DIR_ENV):
+        os.environ[TELEMETRY_DIR_ENV] = tempfile.mkdtemp(
+            prefix="raydp-bench-trace-"
+        )
+    return path
+
+
+def _write_trace_out(path) -> None:
+    try:
+        from raydp_tpu.telemetry import (
+            flush_spans,
+            telemetry_dir,
+            write_chrome_trace,
+        )
+
+        flush_spans()
+        out = write_chrome_trace(telemetry_dir(), path)
+        _STATE["notes"].append(f"chrome trace written to {out}")
+    except Exception as exc:  # tracing must never sink the bench run
+        _STATE["notes"].append(
+            f"trace-out failed: {type(exc).__name__}: {exc}"
+        )
+
+
 def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv and argv[0] == "--chip-worker":
         sidecar = argv[1]
         budget = float(argv[argv.index("--budget") + 1])
         return _chip_worker(sidecar, budget)
+    trace_out = _parse_trace_out(argv)
 
     signal.signal(signal.SIGTERM, _on_signal)
     signal.signal(signal.SIGINT, _on_signal)
@@ -1689,6 +1736,8 @@ def main(argv=None):
                 f"timeout after {probe.attempts} probe attempts); "
                 "model configs ran on CPU at fallback sizes"
             )
+    if trace_out is not None:
+        _write_trace_out(trace_out)
     _emit()
     return 0
 

@@ -192,6 +192,19 @@ class RemoteCluster:
 
         return render_prometheus(self.metrics_snapshot())
 
+    def trace_report(self) -> Optional[dict]:
+        """Analyze the merged trace like ``Cluster.trace_report`` —
+        meaningful when this client shares ``RAYDP_TPU_TELEMETRY_DIR``
+        with the cluster host (same machine or shared filesystem);
+        None when the directory is not configured here."""
+        from raydp_tpu.telemetry import analyze, flush_spans, telemetry_dir
+
+        directory = telemetry_dir()
+        if directory is None:
+            return None
+        flush_spans()
+        return analyze.trace_report(directory)
+
     # -- task submission ------------------------------------------------
     def submit(self, fn, *args, worker_id=None, timeout=300.0, **kwargs):
         return self.submit_async(
@@ -212,6 +225,11 @@ class RemoteCluster:
             "args": args,
             "kwargs": kwargs,
         }
+        # Capture the submitting thread's trace context — the RPC fires
+        # from a pool thread (same reasoning as Cluster.submit_async).
+        from raydp_tpu.telemetry import propagation as _prop
+
+        trace_ctx = _prop.current_context()
 
         def run():
             import grpc
@@ -253,7 +271,11 @@ class RemoteCluster:
                 f"task failed after {retries + 1} attempts: {last}"
             ) from last
 
-        return self._pool.submit(run)
+        def traced_run():
+            with _prop.propagated(trace_ctx):
+                return run()
+
+        return self._pool.submit(traced_run)
 
     def _worker_client(self, info: WorkerInfo) -> RpcClient:
         with self._lock:

@@ -60,6 +60,8 @@ class Cluster:
         self._restarts_used = 0
         self._elastic_stop = threading.Event()
         self._elastic_thread: Optional[threading.Thread] = None
+        self._trace_ctx = None
+        self._metrics_server = None
         self._log_dir = os.path.join(
             "/tmp/raydp_tpu", f"{_slug(config.app_name)}-{os.getpid()}"
         )
@@ -67,6 +69,19 @@ class Cluster:
     # -- lifecycle ------------------------------------------------------
     def start(self) -> None:
         os.makedirs(self._log_dir, exist_ok=True)
+        # Job-level trace root: every span recorded anywhere in this
+        # cluster — driver threads, master handlers, worker processes —
+        # parents under this context, so a whole job merges into ONE
+        # trace (workers inherit it via RAYDP_TPU_TRACEPARENT in their
+        # launch env, driver threads via the process context).
+        from raydp_tpu.telemetry import propagation as _prop
+
+        self._trace_ctx = _prop.mint_context(
+            "cluster/job",
+            app=self.config.app_name,
+            namespace=self.namespace,
+        )
+        _prop.set_process_context(self._trace_ctx)
         nodes = (
             pl.detect_nodes(self.config.num_virtual_nodes)
             if self.config.num_virtual_nodes
@@ -105,6 +120,27 @@ class Cluster:
         )
         self._elastic_thread.start()
         self._warm_workers_async()
+        self._serve_metrics()
+
+    def _serve_metrics(self) -> None:
+        """Expose the merged Prometheus view at ``/metrics`` when
+        RAYDP_TPU_METRICS_PORT is set (the k8s manifests' scrape
+        target). Best-effort: a taken port must not fail cluster start."""
+        from raydp_tpu.telemetry import METRICS_PORT_ENV, serve_prometheus
+
+        port = os.environ.get(METRICS_PORT_ENV)
+        if not port:
+            return
+        try:
+            self._metrics_server = serve_prometheus(
+                self.prometheus_metrics, int(port)
+            )
+            logger.info(
+                "prometheus scrape endpoint on :%d/metrics",
+                self._metrics_server.port,
+            )
+        except Exception:
+            logger.exception("metrics endpoint failed to start")
 
     def _warm_workers_async(self) -> None:
         """Pre-import the ETL stack on every worker in the background.
@@ -205,6 +241,7 @@ class Cluster:
                 ],
                 node_id=node_id,
                 log_path=os.path.join(self._log_dir, f"agent-{node_id}.log"),
+                env=self._child_trace_env(),
                 cwd=_repo_root(),
             )
             with self._lock:
@@ -251,6 +288,11 @@ class Cluster:
         bundle = self.pg.bundles[index % len(self.pg.bundles)]
         return bundle.node_id or "node-0"
 
+    def _child_trace_env(self) -> Dict[str, str]:
+        from raydp_tpu.telemetry import propagation as _prop
+
+        return _prop.env_for_child(self._trace_ctx)
+
     def _spawn_worker(self, node_id: Optional[str] = None) -> str:
         seq = next(self._worker_seq)
         worker_id = f"w{seq}"
@@ -275,7 +317,7 @@ class Cluster:
             ],
             node_id=node_id,
             log_path=os.path.join(self._log_dir, f"{worker_id}.log"),
-            env={"JAX_PLATFORMS": "cpu"},
+            env={"JAX_PLATFORMS": "cpu", **self._child_trace_env()},
             cwd=_repo_root(),
         )
         proc = self.launcher.launch(spec)
@@ -314,6 +356,13 @@ class Cluster:
                 self._stop_worker(worker_id, kill_objects=False)
             self._flush_telemetry()
         self._pool.shutdown(wait=False)
+        if self._metrics_server is not None:
+            try:
+                self._metrics_server.close()
+            except Exception:  # pragma: no cover - teardown best-effort
+                pass
+            self._metrics_server = None
+        self._reset_trace_context()
         if self.master is not None:
             if del_obj_holder:
                 self.release_holder()
@@ -321,6 +370,18 @@ class Cluster:
         # objects on remote nodes must remain fetchable until
         # release_holder() (reference: stop_spark(del_obj_holder=False),
         # context.py:208-215).
+
+    def _reset_trace_context(self) -> None:
+        """Drop the job trace context — but only if it is still OURS:
+        a driver may start a second cluster before fully tearing down
+        the first, and that cluster's context must survive."""
+        if self._trace_ctx is None:
+            return
+        from raydp_tpu.telemetry import propagation as _prop
+
+        if _prop.process_context() == self._trace_ctx:
+            _prop.set_process_context(None)
+        self._trace_ctx = None
 
     def _flush_telemetry(self) -> None:
         """Persist lifecycle events + driver spans to JSONL on graceful
@@ -458,6 +519,20 @@ class Cluster:
 
         return render_prometheus(self.metrics_snapshot())
 
+    def trace_report(self) -> Optional[dict]:
+        """Critical path + per-rank step skew over the job's merged
+        trace (see :mod:`raydp_tpu.telemetry.analyze`). Flushes the
+        driver's own spans first; worker spans arrive as workers flush
+        (each heartbeat and on exit). None unless
+        ``RAYDP_TPU_TELEMETRY_DIR`` is configured."""
+        from raydp_tpu.telemetry import analyze, flush_spans, telemetry_dir
+
+        directory = telemetry_dir()
+        if directory is None:
+            return None
+        flush_spans()
+        return analyze.trace_report(directory)
+
     # -- task submission --------------------------------------------------
     def submit(
         self,
@@ -486,6 +561,13 @@ class Cluster:
             "args": args,
             "kwargs": kwargs,
         }
+        # The RunTask RPC fires from a pool thread; capture the
+        # SUBMITTING thread's trace context here so the worker-side task
+        # span parents under e.g. the driver's df/stage span instead of
+        # the bare job root.
+        from raydp_tpu.telemetry import propagation as _prop
+
+        trace_ctx = _prop.current_context()
 
         def run():
             import grpc
@@ -549,7 +631,11 @@ class Cluster:
                 f"task failed after {retries + 1} attempts: {last}"
             ) from last
 
-        return self._pool.submit(run)
+        def traced_run():
+            with _prop.propagated(trace_ctx):
+                return run()
+
+        return self._pool.submit(traced_run)
 
     def map_tasks(
         self,

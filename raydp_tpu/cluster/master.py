@@ -12,6 +12,14 @@ Runs as a gRPC service in a thread of the driver process (default) so
 holder-owned objects survive worker teardown for the driver's lifetime;
 the service boundary means workers and remote drivers speak to it the
 same way a detached deployment would.
+
+Tracing: handlers run inside the caller's propagated trace context
+(``RpcServer._wrap`` installs the request's ``traceparent``), so the
+lifecycle events recorded here — ``cluster/worker_registered``,
+``cluster/worker_stopped`` — attach to the job trace of the worker that
+called in. ``cluster/worker_dead`` fires from the monitor thread, which
+carries no request context and parents under the driver's process-level
+job context instead.
 """
 from __future__ import annotations
 

@@ -6,15 +6,26 @@ generic method handlers so no protoc codegen is needed (grpcio-tools is
 not in this image); messages are Python dicts pickled with cloudpickle
 (which also lets task payloads carry closures, the reference's MPI
 function-shipping pattern — reference: python/raydp/mpi/mpi_job.py:321-335).
+
+Trace propagation rides the envelope: the client stamps the caller's
+trace context into the request dict as ``traceparent``
+(:func:`raydp_tpu.telemetry.propagation.inject`) and the server runs
+each handler inside ``propagated(ctx)``, so spans recorded on handler
+threads parent under the caller's span. The key is left in the request
+— handlers that defer work to another thread (the SPMD runner queue)
+forward it themselves.
 """
 from __future__ import annotations
 
+import contextlib
 import threading
 from concurrent import futures
 from typing import Any, Callable, Dict, Optional
 
 import cloudpickle
 import grpc
+
+from raydp_tpu.telemetry import propagation as _prop
 
 
 def _identity(b: bytes) -> bytes:
@@ -78,7 +89,14 @@ class RpcServer:
         def handler(request_bytes: bytes, context) -> bytes:
             try:
                 request = cloudpickle.loads(request_bytes)
-                reply = fn(request)
+                ctx = _prop.extract(request)
+                scope = (
+                    _prop.propagated(ctx)
+                    if ctx is not None
+                    else contextlib.nullcontext()
+                )
+                with scope:
+                    reply = fn(request)
                 return cloudpickle.dumps({"ok": True, "value": reply})
             except Exception as exc:  # ship the error to the caller
                 import traceback
@@ -129,7 +147,7 @@ class RpcClient:
                 )
                 self._stubs[method] = stub
         reply_bytes = stub(
-            cloudpickle.dumps(request or {}),
+            cloudpickle.dumps(_prop.inject(request or {})),
             timeout=timeout if timeout is not None else self._timeout,
         )
         reply = cloudpickle.loads(reply_bytes)

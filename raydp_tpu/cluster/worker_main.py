@@ -14,6 +14,7 @@ results go through the shm object store and return ObjectRefs.
 from __future__ import annotations
 
 import argparse
+import atexit
 import logging
 import os
 import sys
@@ -25,7 +26,8 @@ import cloudpickle
 
 from raydp_tpu.cluster.rpc import RpcClient, RpcServer
 from raydp_tpu.store.object_store import ObjectStore
-from raydp_tpu.telemetry import MetricsShipper
+from raydp_tpu.telemetry import MetricsShipper, flush_spans, span
+from raydp_tpu.telemetry import propagation as trace_prop
 from raydp_tpu.utils.profiling import metrics
 
 logger = logging.getLogger(__name__)
@@ -173,8 +175,13 @@ class Worker:
             args = req.get("args", ())
             kwargs = req.get("kwargs", {})
             metrics.counter_add("worker/tasks")
-            with metrics.timer("worker/task").time():
-                result = fn(self.ctx, *args, **kwargs)
+            # RpcServer already installed the caller's traceparent as
+            # this handler thread's ambient context, so this span — and
+            # any span the task body opens — lands in the driver's
+            # job trace, under the submitting stage span.
+            with span("worker/task", worker_id=self.worker_id):
+                with metrics.timer("worker/task").time():
+                    result = fn(self.ctx, *args, **kwargs)
             return {"result": result}
         except Exception:
             # Let RpcServer._wrap serialize the failure uniformly.
@@ -199,6 +206,10 @@ class Worker:
             if delta:
                 beat["metrics"] = delta
             reply = self.master.try_call("Heartbeat", beat, timeout=8.0)
+            # Shard spans continuously (no-op without a telemetry dir):
+            # the driver's live trace_report() sees worker spans at
+            # heartbeat latency, and a later SIGKILL loses ≤1 beat.
+            flush_spans()
             with self._busy_lock:
                 busy = self._busy > 0
             if reply is None:
@@ -256,6 +267,9 @@ class Worker:
             {"worker_id": self.worker_id, "metrics": self._shipper.full()},
             timeout=2.0,
         )
+        # Tail spans of a clean exit (the atexit hook is a backstop for
+        # paths that bypass run(), e.g. a registration failure).
+        flush_spans()
         self._server.stop()
 
 
@@ -273,6 +287,11 @@ def main(argv=None) -> int:
         level=logging.INFO,
         format=f"[{args.worker_id}] %(levelname)s %(message)s",
     )
+    # Join the driver's job trace (RAYDP_TPU_TRACEPARENT in our launch
+    # env) before any span is recorded; flush tail spans on interpreter
+    # exit so clean shutdowns never lose the last buffer.
+    trace_prop.adopt_env_context()
+    atexit.register(flush_spans)
     worker = Worker(
         args.worker_id,
         args.master,

@@ -32,7 +32,7 @@ from typing import Dict, Iterator, List, Optional, Tuple
 import numpy as np
 
 from raydp_tpu.native import lib as native
-from raydp_tpu.telemetry import span
+from raydp_tpu.telemetry import current_context, propagated, span
 from raydp_tpu.utils.profiling import metrics
 
 # Auto transfer-chunk sizing: coalesce batches until a chunk reaches this
@@ -277,10 +277,16 @@ def _background(it: Iterator, depth: int):
     """Run ``it`` in a daemon thread, buffering ``depth`` items.
 
     Returns ``(iterator, stop_event)``; setting the event makes the
-    producer drain out promptly (a full queue never blocks it forever)."""
+    producer drain out promptly (a full queue never blocks it forever).
+
+    The consumer's trace context is captured HERE (typically inside the
+    epoch span) and installed on the producer thread, so the
+    ``ingest/*`` spans it records nest in the training trace instead of
+    starting a fresh one per epoch."""
     q: "queue.Queue" = queue.Queue(maxsize=depth)
     _DONE = object()
     stop = threading.Event()
+    trace_ctx = current_context()
 
     def _put(item) -> bool:
         while not stop.is_set():
@@ -292,13 +298,14 @@ def _background(it: Iterator, depth: int):
         return False
 
     def producer():
-        try:
-            for item in it:
-                if not _put(item):
-                    return
-            _put(_DONE)
-        except BaseException as exc:  # surface errors on the consumer side
-            _put(exc)
+        with propagated(trace_ctx):
+            try:
+                for item in it:
+                    if not _put(item):
+                        return
+                _put(_DONE)
+            except BaseException as exc:  # surface errors on consumer side
+                _put(exc)
 
     thread = threading.Thread(target=producer, daemon=True)
     thread.start()

@@ -163,6 +163,8 @@ class SPMDJob:
         self._gen = 0  # incarnation counter scoping watcher threads
         self._stopping = False
         self._log_paths: List[str] = []
+        self._trace_ctx = None
+        self._owns_trace_ctx = False
         # Per-rank metrics merged from heartbeat-shipped deltas; survives
         # gang restarts (ranks keep their keys across incarnations).
         self.telemetry = ClusterTelemetry()
@@ -215,6 +217,19 @@ class SPMDJob:
         if self.script_prepare_fn is not None:
             prefix = list(self.script_prepare_fn(ctx) or [])
 
+        # Gang trace context: reuse the driver's ambient context when one
+        # exists (an SPMD job inside a Cluster joins the cluster's job
+        # trace); a standalone job mints its own root.
+        from raydp_tpu.telemetry import propagation as trace_prop
+
+        self._trace_ctx = trace_prop.current_context()
+        self._owns_trace_ctx = self._trace_ctx is None
+        if self._trace_ctx is None:
+            self._trace_ctx = trace_prop.mint_context(
+                "spmd/job", job=self.job_name, world_size=self.world_size
+            )
+            trace_prop.set_process_context(self._trace_ctx)
+
         log_dir = os.path.join(
             "/tmp/raydp_tpu", "spmd", f"{self.job_name}-{os.getpid()}"
         )
@@ -231,6 +246,7 @@ class SPMDJob:
                     ENV_DRIVER_ADDR: driver_addr,
                     ENV_COORDINATOR: coordinator,
                     ENV_PROCS_PER_NODE: str(self.num_procs_per_node),
+                    **trace_prop.env_for_child(self._trace_ctx),
                 }
             )
             cmd = prefix + [sys.executable, "-m", "raydp_tpu.spmd.worker_main"]
@@ -478,6 +494,13 @@ class SPMDJob:
         self._worker_hosts = {}
         self._inflight = None
         self._started = False
+        if self._owns_trace_ctx and self._trace_ctx is not None:
+            from raydp_tpu.telemetry import propagation as trace_prop
+
+            if trace_prop.process_context() == self._trace_ctx:
+                trace_prop.set_process_context(None)
+        self._trace_ctx = None
+        self._owns_trace_ctx = False
 
     def __enter__(self) -> "SPMDJob":
         if not self._started:

@@ -12,6 +12,8 @@ span the whole gang — the role MPI collectives play in the reference.
 """
 from __future__ import annotations
 
+import atexit
+import contextlib
 import logging
 import os
 import queue
@@ -33,7 +35,8 @@ from raydp_tpu.spmd.job import (
     ENV_WORLD_SIZE,
     WORKER_SERVICE,
 )
-from raydp_tpu.telemetry import MetricsShipper
+from raydp_tpu.telemetry import MetricsShipper, flush_spans, span
+from raydp_tpu.telemetry import propagation as trace_prop
 from raydp_tpu.utils.net import local_ip
 
 logger = logging.getLogger(__name__)
@@ -124,16 +127,31 @@ class SPMDWorker:
                 continue
             self._last_func_id = func_id
             value, error = None, None
-            try:
-                fn = cloudpickle.loads(item["fn"])
-                args = (
-                    cloudpickle.loads(item["args"])
-                    if item.get("args") is not None
-                    else ()
-                )
-                value = fn(self.ctx, *args)
-            except Exception:
-                error = traceback.format_exc()
+            # The RunFunction handler only enqueues; THIS thread does the
+            # work — so RPC-level ambient context does not cover it. The
+            # traceparent key travels in the queued request instead, and
+            # the execution span parents under the driver's
+            # spmd/dispatch span.
+            ctx = trace_prop.extract(item)
+            scope = (
+                trace_prop.propagated(ctx)
+                if ctx is not None
+                else contextlib.nullcontext()
+            )
+            with scope, span(
+                "spmd/func", rank=self.rank, func_id=func_id
+            ) as sp:
+                try:
+                    fn = cloudpickle.loads(item["fn"])
+                    args = (
+                        cloudpickle.loads(item["args"])
+                        if item.get("args") is not None
+                        else ()
+                    )
+                    value = fn(self.ctx, *args)
+                except Exception:
+                    error = traceback.format_exc()
+                    sp.status = "error"
             reply = self.driver.try_call(
                 "FuncResult",
                 {
@@ -168,6 +186,9 @@ class SPMDWorker:
             delta = shipper.delta()
             if delta:
                 beat["metrics"] = delta
+            # Shard this rank's spans continuously (no-op without a
+            # telemetry dir) so a driver-side trace_report sees them live.
+            flush_spans()
             if self.driver.try_call("Ping", beat, timeout=5.0) is None:
                 shipper.rollback(delta)  # re-ship the delta next beat
                 missed += 1
@@ -197,6 +218,7 @@ class SPMDWorker:
         threading.Thread(target=self._heartbeat, daemon=True).start()
         self._stop_event.wait()
         runner.join(timeout=2.0)
+        flush_spans()  # tail spans of a clean stop (atexit is backstop)
         self._server.stop()
         self.driver.close()
         return 0
@@ -207,6 +229,10 @@ def main() -> int:
         level=logging.INFO,
         format=f"[spmd-{os.environ.get(ENV_RANK, '?')}] %(levelname)s %(message)s",
     )
+    # Join the driver's job trace before any span is recorded; flush
+    # tail spans on interpreter exit.
+    trace_prop.adopt_env_context()
+    atexit.register(flush_spans)
     try:
         return SPMDWorker().run()
     except Exception:

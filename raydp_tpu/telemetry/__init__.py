@@ -1,30 +1,62 @@
 """Cluster-wide telemetry plane.
 
-Three layers, all stdlib-only (importable from worker entry points
+Five layers, all stdlib-only (importable from worker entry points
 without pulling in jax):
 
 * :mod:`~raydp_tpu.telemetry.spans` — structured spans with parent
   links and an in-process ring buffer, wired into the framework's hot
   paths (loader chunk staging, estimator epochs/steps, SPMD dispatch,
   DataFrame stages, master worker lifecycle).
+* :mod:`~raydp_tpu.telemetry.propagation` — cross-process /
+  cross-thread trace context: the driver mints a job context, the RPC
+  envelope and worker launch env carry a ``traceparent``, and the
+  ``current_context()`` / ``propagated(ctx)`` API parents producer and
+  handler threads, so one ``fit()`` yields ONE trace across the gang.
 * :mod:`~raydp_tpu.telemetry.shipping` — delta-encoded
   ``metrics.snapshot()`` payloads piggybacked on existing heartbeat
   RPCs; the master merges them into a per-worker cluster view that
   survives worker death (tombstoned final snapshots).
 * :mod:`~raydp_tpu.telemetry.export` — the merged view as Prometheus
-  text exposition v0.0.4, plus append-only JSONL span/event logs under
+  text exposition v0.0.4 (optionally served at ``/metrics``), plus
+  append-only per-process JSONL span shards under
   ``RAYDP_TPU_TELEMETRY_DIR``.
+* :mod:`~raydp_tpu.telemetry.chrome_trace` /
+  :mod:`~raydp_tpu.telemetry.analyze` — merge the shards into a
+  Perfetto-loadable Chrome trace (clock-aligned), extract the critical
+  path, and report per-rank step skew + data-wait vs compute
+  (``python -m raydp_tpu.telemetry.analyze <dir>`` or
+  ``Cluster.trace_report()``).
 
 Drivers pull the live aggregate with ``Cluster.metrics_snapshot()``
 (works identically through ``raydp_tpu.connect`` client sessions).
 See ``doc/telemetry.md``.
 """
+from raydp_tpu.telemetry.chrome_trace import (
+    load_span_records,
+    to_chrome_trace,
+    write_chrome_trace,
+)
 from raydp_tpu.telemetry.export import (
+    METRICS_PORT_ENV,
     TELEMETRY_DIR_ENV,
     flush_spans,
     render_prometheus,
+    serve_prometheus,
     telemetry_dir,
     write_events,
+)
+from raydp_tpu.telemetry.propagation import (
+    TRACEPARENT_ENV,
+    TraceContext,
+    adopt_env_context,
+    current_context,
+    env_for_child,
+    from_traceparent,
+    mint_context,
+    process_context,
+    propagated,
+    set_process_context,
+    to_traceparent,
 )
 from raydp_tpu.telemetry.shipping import ClusterTelemetry, MetricsShipper
 from raydp_tpu.telemetry.spans import Span, SpanRecorder, event, recorder, span
@@ -32,14 +64,30 @@ from raydp_tpu.telemetry.spans import Span, SpanRecorder, event, recorder, span
 __all__ = [
     "Span",
     "SpanRecorder",
+    "TraceContext",
     "recorder",
     "span",
     "event",
     "MetricsShipper",
     "ClusterTelemetry",
     "TELEMETRY_DIR_ENV",
+    "METRICS_PORT_ENV",
+    "TRACEPARENT_ENV",
     "telemetry_dir",
     "flush_spans",
     "write_events",
     "render_prometheus",
+    "serve_prometheus",
+    "current_context",
+    "propagated",
+    "set_process_context",
+    "process_context",
+    "mint_context",
+    "adopt_env_context",
+    "env_for_child",
+    "to_traceparent",
+    "from_traceparent",
+    "load_span_records",
+    "to_chrome_trace",
+    "write_chrome_trace",
 ]

@@ -14,33 +14,41 @@ Two consumers, two formats:
   - ``raydp_timer_seconds`` summary (quantile samples + ``_sum``/``_count``)
 
 * **JSONL logs** — :func:`flush_spans` drains the process span ring to
-  ``<telemetry_dir>/spans.jsonl``; :func:`write_events` appends master
-  lifecycle events to ``events.jsonl``. One JSON object per line,
-  append-only, safe to tail while the job runs.
+  a per-process ``<telemetry_dir>/spans-<pid>.jsonl`` shard (so
+  concurrent processes never interleave within a line and the
+  Chrome-trace merger can attribute shards);  :func:`write_events`
+  appends master lifecycle events to ``events.jsonl``. One JSON object
+  per line, append-only, safe to tail while the job runs.
 
 ``telemetry_dir`` is configured with the ``RAYDP_TPU_TELEMETRY_DIR``
 environment variable (inherited by worker subprocesses, so every
 process of a job logs under one directory) or passed explicitly.
+:func:`serve_prometheus` exposes the exposition over a tiny stdlib
+HTTP endpoint for in-cluster scrapes (the k8s manifests annotate pods
+with ``prometheus.io/scrape`` pointing at it).
 """
 from __future__ import annotations
 
 import json
 import os
 import threading
-from typing import Any, Dict, Iterable, List, Optional
+from typing import Any, Callable, Dict, Iterable, List, Optional
 
 from raydp_tpu.telemetry import spans as _spans
 
 __all__ = [
     "TELEMETRY_DIR_ENV",
+    "METRICS_PORT_ENV",
     "telemetry_dir",
     "append_jsonl",
     "flush_spans",
     "write_events",
     "render_prometheus",
+    "serve_prometheus",
 ]
 
 TELEMETRY_DIR_ENV = "RAYDP_TPU_TELEMETRY_DIR"
+METRICS_PORT_ENV = "RAYDP_TPU_METRICS_PORT"
 
 _write_mu = threading.Lock()
 
@@ -66,18 +74,20 @@ def append_jsonl(path: str, records: Iterable[Dict[str, Any]]) -> int:
 def flush_spans(
     directory: Optional[str] = None, recorder: Optional[Any] = None
 ) -> Optional[str]:
-    """Drain the span ring buffer to ``<dir>/spans.jsonl``.
+    """Drain the span ring buffer to ``<dir>/spans-<pid>.jsonl``.
 
-    No-op (buffer left intact) when no directory is configured, so
-    instrumented code calls this unconditionally. Returns the log path
-    when writing happened.
+    One shard per process: every process of a job appends only to its
+    own file, and :mod:`~raydp_tpu.telemetry.chrome_trace` merges the
+    shards. No-op (buffer left intact) when no directory is configured,
+    so instrumented code calls this unconditionally. Returns the shard
+    path when writing happened.
     """
     directory = directory or telemetry_dir()
     if not directory:
         return None
     rec = recorder if recorder is not None else _spans.recorder
     drained = rec.drain()
-    path = os.path.join(directory, "spans.jsonl")
+    path = os.path.join(directory, f"spans-{os.getpid()}.jsonl")
     append_jsonl(path, (s.to_dict() for s in drained))
     return path
 
@@ -170,6 +180,11 @@ def render_prometheus(view: Dict[str, Any]) -> str:
         "raydp_timer_seconds", "summary",
         "StepTimer rolling-window summaries.",
     )
+    dropped = _Family(
+        "raydp_spans_dropped_total", "counter",
+        "Spans evicted from a process's ring buffer before any flush "
+        "drained them (raise RAYDP_TPU_SPAN_BUFFER or flush more often).",
+    )
 
     sources: Dict[str, Dict[str, Any]] = dict(view.get("workers") or {})
     driver = view.get("driver")
@@ -189,6 +204,12 @@ def render_prometheus(view: Dict[str, Any]) -> str:
                 continue
             if key == "counters":
                 for name in sorted(section):
+                    if name == "spans/dropped":
+                        # Span loss is an operability signal, not a
+                        # workload stat: dedicated family so alerts can
+                        # target it without label matching.
+                        dropped.add({"worker": worker_id}, section[name])
+                        continue
                     counters.add(
                         {"worker": worker_id, "name": name}, section[name]
                     )
@@ -207,6 +228,61 @@ def render_prometheus(view: Dict[str, Any]) -> str:
                 timers.add(labels, section.get("count", 0.0), suffix="_count")
 
     lines: List[str] = []
-    for family in (up, counters, meter_total, meter_rate, timers):
+    for family in (up, counters, meter_total, meter_rate, timers, dropped):
         lines.extend(family.render())
     return "\n".join(lines) + ("\n" if lines else "")
+
+
+# -- scrape endpoint ----------------------------------------------------
+
+
+class _ScrapeServer:
+    """Handle to a running :func:`serve_prometheus` endpoint."""
+
+    def __init__(self, httpd, thread):
+        self._httpd = httpd
+        self._thread = thread
+        self.port = httpd.server_address[1]
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=2.0)
+
+
+def serve_prometheus(
+    render: Callable[[], str], port: int, host: str = "0.0.0.0"
+) -> _ScrapeServer:
+    """Serve ``render()`` (exposition text) at ``/metrics`` on a daemon
+    thread — the in-cluster scrape target the k8s manifests annotate.
+    Stdlib ``http.server`` only: one scrape every few seconds, no need
+    for more. Returns a handle with ``.port`` and ``.close()``."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 - http.server API
+            if self.path.split("?")[0] not in ("/metrics", "/"):
+                self.send_error(404)
+                return
+            try:
+                body = render().encode("utf-8")
+            except Exception as exc:  # render must not kill the endpoint
+                self.send_error(500, str(exc))
+                return
+            self.send_response(200)
+            self.send_header(
+                "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+            )
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # silence per-scrape stderr noise
+            pass
+
+    httpd = ThreadingHTTPServer((host, port), Handler)
+    thread = threading.Thread(
+        target=httpd.serve_forever, name="raydp-metrics-http", daemon=True
+    )
+    thread.start()
+    return _ScrapeServer(httpd, thread)

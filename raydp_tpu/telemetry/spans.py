@@ -8,12 +8,18 @@ timestamps; finished spans land in an in-process ring buffer that
 :func:`raydp_tpu.telemetry.export.flush_spans` drains to an append-only
 JSONL log.
 
-Parent links come from a per-thread stack: a span started while another
-span is open on the same thread becomes its child (estimator step spans
-nest under the epoch span). Spans recorded on other threads — the
-loader's prefetch producer, RPC handler threads — start fresh traces;
-cross-thread parenting is deliberately out of scope (no context
-propagation machinery on the hot path).
+Parent links come from two sources, consulted in order:
+
+1. the per-thread stack — a span started while another span is open on
+   the same thread becomes its child (estimator step spans nest under
+   the epoch span);
+2. an *ambient* :class:`TraceContext` — when the thread's stack is
+   empty, the thread-local context installed by
+   :meth:`SpanRecorder.propagated` wins, then the process-level context
+   installed by :meth:`SpanRecorder.set_process_context`. This is how
+   spans on loader producer threads, RPC handler threads, and freshly
+   spawned worker processes join the driver's job trace instead of
+   starting fresh ones (see :mod:`raydp_tpu.telemetry.propagation`).
 
 Hot-path cost: one ``perf_counter`` pair, a dict, and a locked deque
 append per span. Instrumented paths put spans at chunk/step/stage
@@ -30,11 +36,29 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterator, List, Optional
 
-__all__ = ["Span", "SpanRecorder", "recorder", "span", "event"]
+__all__ = [
+    "Span",
+    "SpanRecorder",
+    "TraceContext",
+    "recorder",
+    "span",
+    "event",
+]
 
 # Ring capacity: big enough to hold a full small training run's spans,
 # bounded so an unflushed long job cannot grow without limit.
 _CAPACITY = int(os.environ.get("RAYDP_TPU_SPAN_BUFFER", "4096"))
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    """A point in a trace another span can parent under.
+
+    Defined here (not in :mod:`~raydp_tpu.telemetry.propagation`) so the
+    recorder can consume it without an import cycle."""
+
+    trace_id: str
+    span_id: str
 
 
 @dataclass
@@ -52,12 +76,16 @@ class Span:
     end_mono: Optional[float] = None
     status: str = "ok"  # ok | error
     kind: str = "span"  # span | event (zero-duration point annotation)
+    tid: int = 0  # recording thread — one Perfetto track per thread
 
     @property
     def duration_s(self) -> Optional[float]:
         if self.end_mono is None:
             return None
         return self.end_mono - self.start_mono
+
+    def context(self) -> TraceContext:
+        return TraceContext(self.trace_id, self.span_id)
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -73,6 +101,7 @@ class Span:
             "kind": self.kind,
             "attrs": self.attrs,
             "pid": os.getpid(),
+            "tid": self.tid,
         }
 
 
@@ -84,12 +113,16 @@ class SpanRecorder:
         self._mu = threading.Lock()
         self._tls = threading.local()
         self._seq = itertools.count(1)
+        self._dropped = 0
+        self._process_ctx: Optional[TraceContext] = None
+        # Random salt on top of the pid: two hosts (or a pid recycled
+        # across worker restarts) must never mint colliding span ids,
+        # since parent links cross process boundaries via traceparent.
+        self._id_prefix = f"{os.getpid():x}.{os.urandom(2).hex()}"
 
     # -- id scheme ------------------------------------------------------
     def _next_id(self, seq: int) -> str:
-        # pid-qualified so logs from several processes appended to one
-        # JSONL file never collide.
-        return f"{os.getpid():x}-{seq:x}"
+        return f"{self._id_prefix}-{seq:x}"
 
     def _stack(self) -> List[Span]:
         st = getattr(self._tls, "stack", None)
@@ -98,12 +131,49 @@ class SpanRecorder:
             self._tls.stack = st
         return st
 
+    # -- ambient context ------------------------------------------------
+    def _ambient(self) -> Optional[TraceContext]:
+        ctx = getattr(self._tls, "ambient", None)
+        return ctx if ctx is not None else self._process_ctx
+
+    def current_context(self) -> Optional[TraceContext]:
+        """Where a new span on this thread would attach: the innermost
+        open span, else the thread's propagated context, else the
+        process context. None means a new span starts a fresh trace."""
+        stack = self._stack()
+        if stack:
+            return stack[-1].context()
+        return self._ambient()
+
+    @contextlib.contextmanager
+    def propagated(self, ctx: Optional[TraceContext]) -> Iterator[None]:
+        """Install ``ctx`` as this thread's ambient trace context for the
+        duration of the block. ``None`` clears any thread-level override
+        (the process context still applies). Used by RPC handler threads
+        and loader producer threads to parent under a context captured
+        elsewhere."""
+        prev = getattr(self._tls, "ambient", None)
+        self._tls.ambient = ctx
+        try:
+            yield
+        finally:
+            self._tls.ambient = prev
+
+    def set_process_context(self, ctx: Optional[TraceContext]) -> None:
+        """Default parent for every span recorded with no open span and
+        no thread override — how a worker process adopts the driver's
+        job trace for its whole lifetime."""
+        self._process_ctx = ctx
+
+    def process_context(self) -> Optional[TraceContext]:
+        return self._process_ctx
+
     # -- lifecycle ------------------------------------------------------
     def start(self, name: str, **attrs: Any) -> Span:
-        """Open a span; the current thread's innermost open span (if any)
-        becomes its parent. Pair with :meth:`finish`."""
+        """Open a span; the current thread's innermost open span (or the
+        ambient context) becomes its parent. Pair with :meth:`finish`."""
         stack = self._stack()
-        parent = stack[-1] if stack else None
+        parent = stack[-1].context() if stack else self._ambient()
         seq = next(self._seq)
         span_id = self._next_id(seq)
         sp = Span(
@@ -115,6 +185,7 @@ class SpanRecorder:
             start_wall=time.time(),
             start_mono=time.perf_counter(),
             attrs=attrs,
+            tid=threading.get_ident(),
         )
         stack.append(sp)
         return sp
@@ -130,8 +201,7 @@ class SpanRecorder:
             if stack[i] is sp:
                 del stack[i]
                 break
-        with self._mu:
-            self._buf.append(sp)
+        self._append(sp)
 
     @contextlib.contextmanager
     def span(self, name: str, **attrs: Any) -> Iterator[Span]:
@@ -148,7 +218,7 @@ class SpanRecorder:
         """Zero-duration point annotation (worker registered, worker
         dead, …), parented like a span."""
         stack = self._stack()
-        parent = stack[-1] if stack else None
+        parent = stack[-1].context() if stack else self._ambient()
         seq = next(self._seq)
         span_id = self._next_id(seq)
         now = time.perf_counter()
@@ -163,12 +233,36 @@ class SpanRecorder:
             attrs=attrs,
             end_mono=now,
             kind="event",
+            tid=threading.get_ident(),
         )
-        with self._mu:
-            self._buf.append(sp)
+        self._append(sp)
         return sp
 
     # -- buffer access --------------------------------------------------
+    def _append(self, sp: Span) -> None:
+        evicted = False
+        with self._mu:
+            if self._buf.maxlen is not None and len(self._buf) == self._buf.maxlen:
+                evicted = True
+                self._dropped += 1
+            self._buf.append(sp)
+        if evicted:
+            # Count outside the recorder lock; the metrics counter ships
+            # on heartbeats (raydp_spans_dropped_total per worker), so
+            # ring evictions are never silent.
+            try:
+                from raydp_tpu.utils.profiling import metrics
+
+                metrics.counter_add("spans/dropped")
+            except Exception:  # pragma: no cover - accounting best-effort
+                pass
+
+    @property
+    def dropped(self) -> int:
+        """Spans evicted from the ring before a flush drained them."""
+        with self._mu:
+            return self._dropped
+
     def drain(self) -> List[Span]:
         """Remove and return all finished spans (oldest first)."""
         with self._mu:

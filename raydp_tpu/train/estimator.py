@@ -489,6 +489,24 @@ class JAXEstimator:
                 "(label_column may be omitted with self_supervised=True)"
             )
         epochs = num_epochs if num_epochs is not None else self.num_epochs
+        # One root span per fit: everything below — epoch/step spans on
+        # this thread, ingest spans on producer threads, worker-side
+        # task spans — parents under it (directly or via propagation),
+        # so a whole fit reads as one tree in the merged trace.
+        with span("train/fit", epochs=epochs):
+            history = self._fit(train_ds, evaluate_ds, epochs, resume_from)
+        # The last _finish_epoch flushed BEFORE the fit span closed;
+        # flush again so the root span itself reaches the shard.
+        flush_spans()
+        return history
+
+    def _fit(
+        self,
+        train_ds: MLDataset,
+        evaluate_ds: Optional[MLDataset],
+        epochs: int,
+        resume_from: Optional[str],
+    ) -> List[Dict[str, float]]:
         if self._use_scan(train_ds) and resume_from is None:
             # What actually ran, for callers that report it ('auto' and
             # multi-process fallbacks make the configured mode a lie).

@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Tier-1 verification gate (see ROADMAP.md): the fast, CPU-only test
+# suite every change must keep green. Runs from any cwd.
+#
+#   scripts/verify.sh [extra pytest args]
+#
+# Prints DOTS_PASSED=<n> (count of progress dots = passing tests) and
+# exits with pytest's status.
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+LOG="${T1_LOG:-/tmp/_t1.log}"
+rm -f "$LOG"
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+  -m 'not slow' --continue-on-collection-errors \
+  -p no:cacheprovider -p no:xdist -p no:randomly "$@" 2>&1 | tee "$LOG"
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)
+exit $rc

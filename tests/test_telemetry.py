@@ -275,8 +275,9 @@ def test_render_prometheus_empty_view():
 
 
 def test_estimator_writes_nested_span_log(tmp_path, monkeypatch):
-    """An estimator epoch flushes a spans.jsonl where step spans nest
-    under their epoch span and chunk spans closed before being consumed."""
+    """An estimator epoch flushes a spans-<pid>.jsonl shard where step
+    spans nest under their epoch span and chunk spans closed before
+    being consumed."""
     import numpy as np
     import pandas as pd
 
@@ -301,7 +302,7 @@ def test_estimator_writes_nested_span_log(tmp_path, monkeypatch):
     )
     est.fit_on_df(df)
 
-    log = tmp_path / "spans.jsonl"
+    log = tmp_path / f"spans-{os.getpid()}.jsonl"
     assert log.exists()
     records = [json.loads(line) for line in log.read_text().splitlines()]
     epochs = [r for r in records if r["name"] == "train/epoch"]
