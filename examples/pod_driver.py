@@ -98,8 +98,33 @@ def run_host(args):
                 ]
             )
         )
-    for p in procs:
-        p.wait()
+    # Host pods answer the k8s /healthz probes themselves (the driver
+    # pod's endpoint comes from Cluster.start): healthy while every
+    # child process of this pod is still alive. Workers additionally
+    # serve their own per-process endpoints when RAYDP_TPU_DEBUG_PORT
+    # is set (use 0 — several workers share this pod).
+    server = None
+    port = os.environ.get("RAYDP_TPU_METRICS_PORT")
+    if port:
+        from raydp_tpu.telemetry import serve_prometheus
+
+        def pod_health():
+            dead = [p.pid for p in procs if p.poll() is not None]
+            return {"healthy": not dead, "dead_children": dead,
+                    "node_id": node_id}
+
+        try:
+            server = serve_prometheus(
+                lambda: "", int(port), health=pod_health
+            )
+        except Exception:
+            print(f"host {node_id}: debug endpoint failed", file=sys.stderr)
+    try:
+        for p in procs:
+            p.wait()
+    finally:
+        if server is not None:
+            server.close()
 
 
 def run_smoke():

@@ -128,6 +128,9 @@ class _RemoteMaster:
     def metrics_snapshot(self) -> dict:
         return self._client.call("MetricsSnapshot", {})["snapshot"]
 
+    def health_report(self) -> dict:
+        return self._client.call("HealthReport", {})["report"]
+
     def mark_worker_dead(self, worker_id: str, reason: str = "") -> None:
         # Best-effort: the real master's own monitors are authoritative;
         # a client merely stops routing to the worker.
@@ -204,6 +207,12 @@ class RemoteCluster:
             return None
         flush_spans()
         return analyze.trace_report(directory)
+
+    def health_report(self) -> dict:
+        """The remote master's aggregated cluster health (same shape as
+        ``Cluster.health_report``; its ``driver`` entry describes the
+        cluster-owning process, not this client)."""
+        return self.master.health_report()
 
     # -- task submission ------------------------------------------------
     def submit(self, fn, *args, worker_id=None, timeout=300.0, **kwargs):

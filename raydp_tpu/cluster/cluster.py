@@ -82,6 +82,19 @@ class Cluster:
             namespace=self.namespace,
         )
         _prop.set_process_context(self._trace_ctx)
+        # Health plane: arm the driver's flight recorder (no signal
+        # handlers — the driver is the USER's process), structured log
+        # shard, and progress watchdog.
+        from raydp_tpu.telemetry import flight_recorder as _flight
+        from raydp_tpu.telemetry import logs as _logs
+        from raydp_tpu.telemetry import watchdog as _watchdog
+
+        _flight.install(component="driver", signals=False)
+        _logs.install()
+        _watchdog.ensure_started()
+        _flight.record("state", "cluster_start", namespace=self.namespace,
+                       app=self.config.app_name,
+                       num_workers=self.config.num_workers)
         nodes = (
             pl.detect_nodes(self.config.num_virtual_nodes)
             if self.config.num_virtual_nodes
@@ -335,6 +348,10 @@ class Cluster:
         so RPCs to/from the master would race executor shutdown.
         """
         self._elastic_stop.set()  # teardown must never trigger respawns
+        from raydp_tpu.telemetry import flight_recorder as _flight
+
+        _flight.record("state", "cluster_shutdown",
+                       namespace=self.namespace, fast=fast)
         with self._lock:
             worker_ids = list(self._procs)
         if fast:
@@ -532,6 +549,16 @@ class Cluster:
             return None
         flush_spans()
         return analyze.trace_report(directory)
+
+    def health_report(self) -> Optional[dict]:
+        """Aggregated cluster health (parity with :meth:`trace_report`):
+        per-worker heartbeat age + watchdog stall flags shipped on
+        heartbeats, stalled/dead/late worker lists, slowest-rank
+        attribution, and the driver's own watchdog state. None before
+        :meth:`start`."""
+        if self.master is None:
+            return None
+        return self.master.health_report()
 
     # -- task submission --------------------------------------------------
     def submit(

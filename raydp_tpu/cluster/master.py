@@ -58,6 +58,9 @@ class WorkerInfo:
     resources: Dict[str, float]
     state: str = "ALIVE"  # ALIVE | DEAD | STOPPED
     last_heartbeat: float = field(default_factory=time.monotonic)
+    # Watchdog stall flags shipped on the last heartbeat (empty =
+    # healthy): {component: {age_s, since_wall, count, attrs}}.
+    stalls: Dict[str, dict] = field(default_factory=dict)
 
 
 class AppMaster:
@@ -99,6 +102,7 @@ class AppMaster:
             "ListWorkers": self._on_list_workers,
             "ClusterResources": self._on_cluster_resources,
             "MetricsSnapshot": self._on_metrics_snapshot,
+            "HealthReport": self._on_health_report,
             "Ping": lambda req: {"pong": True, "namespace": self.namespace},
         }
         # The master doubles as the driver node's store agent (no extra
@@ -220,6 +224,10 @@ class AppMaster:
             if info is None:
                 return {"known": False}
             info.last_heartbeat = time.monotonic()
+            # Unconditional assignment: a beat without a health payload
+            # means the worker's watchdog sees no stall — recovery
+            # clears the flag without a dedicated RPC.
+            info.stalls = (req.get("health") or {}).get("stalls") or {}
             return {"known": info.state == "ALIVE"}
 
     def _on_worker_stopped(self, req: dict) -> dict:
@@ -314,6 +322,77 @@ class AppMaster:
 
     def _on_metrics_snapshot(self, req: dict) -> dict:
         return {"snapshot": self.metrics_snapshot()}
+
+    def _on_health_report(self, req: dict) -> dict:
+        return {"report": self.health_report()}
+
+    def health_report(self) -> dict:
+        """Aggregated cluster health: per-worker heartbeat age + stall
+        flags, plus slowest-rank attribution from the merged timers.
+
+        Designed to fire BEFORE the heartbeat timeout: a wedged task
+        does not stop the worker's heartbeat thread, so the stall flag
+        arrives on the next beat (~2 s) while ``heartbeat timeout``
+        death detection waits ``HEARTBEAT_TIMEOUT_S``.
+        """
+        from raydp_tpu.telemetry import watchdog as _watchdog
+
+        now = time.monotonic()
+        with self._lock:
+            workers = {
+                wid: {
+                    "state": w.state,
+                    "node_id": w.node_id,
+                    "pid": w.pid,
+                    "heartbeat_age_s": round(now - w.last_heartbeat, 3),
+                    "stalls": dict(w.stalls),
+                }
+                for wid, w in self._workers.items()
+            }
+        stalled = sorted(
+            wid for wid, w in workers.items()
+            if w["stalls"] and w["state"] == "ALIVE"
+        )
+        dead = sorted(
+            wid for wid, w in workers.items() if w["state"] == "DEAD"
+        )
+        late = sorted(
+            wid for wid, w in workers.items()
+            if w["state"] == "ALIVE"
+            and w["heartbeat_age_s"] > HEARTBEAT_TIMEOUT_S / 2
+        )
+        driver = _watchdog.health()
+        return {
+            "healthy": not (stalled or dead or late)
+            and driver.get("healthy", True),
+            "workers": workers,
+            "stalled_workers": stalled,
+            "dead_workers": dead,
+            "late_workers": late,
+            "slowest_rank": self._slowest_rank(),
+            "heartbeat_timeout_s": HEARTBEAT_TIMEOUT_S,
+            "driver": driver,
+        }
+
+    def _slowest_rank(self) -> Optional[dict]:
+        """Straggler attribution from shipped step/task timers (p50:
+        robust to one-off spikes; the cross-worker comparison is what
+        names the slow rank)."""
+        view = self.telemetry.merged()
+        slowest: Optional[dict] = None
+        for wid, sections in (view.get("workers") or {}).items():
+            for key in ("timer/train/step", "timer/worker/task"):
+                sec = sections.get(key)
+                if not sec or not sec.get("p50_s"):
+                    continue
+                if slowest is None or sec["p50_s"] > slowest["p50_s"]:
+                    slowest = {
+                        "worker": wid,
+                        "timer": key[len("timer/"):],
+                        "p50_s": sec["p50_s"],
+                    }
+                break  # prefer train/step when a worker has both
+        return slowest
 
     def metrics_snapshot(self) -> dict:
         """Merged cluster metrics: per-worker views (tombstones

@@ -14,18 +14,27 @@ each handler inside ``propagated(ctx)``, so spans recorded on handler
 threads parent under the caller's span. The key is left in the request
 — handlers that defer work to another thread (the SPMD runner queue)
 forward it themselves.
+
+The health plane rides here too: every client call is bracketed as an
+in-flight ``rpc`` op (a peer that never answers shows up in the
+watchdog's stall report with the method name), and sends/recvs land in
+the flight-recorder ring so a postmortem bundle shows the last
+control-plane traffic before death.
 """
 from __future__ import annotations
 
 import contextlib
 import threading
+import time
 from concurrent import futures
 from typing import Any, Callable, Dict, Optional
 
 import cloudpickle
 import grpc
 
+from raydp_tpu.telemetry import flight_recorder as _flight
 from raydp_tpu.telemetry import propagation as _prop
+from raydp_tpu.telemetry import watchdog as _watchdog
 
 
 def _identity(b: bytes) -> bytes:
@@ -57,7 +66,7 @@ class RpcServer:
         )
         rpc_handlers = {
             name: grpc.unary_unary_rpc_method_handler(
-                self._wrap(fn),
+                self._wrap(f"{service_name}.{name}", fn),
                 request_deserializer=_identity,
                 response_serializer=_identity,
             )
@@ -85,8 +94,9 @@ class RpcServer:
         self._server.start()
 
     @staticmethod
-    def _wrap(fn: Callable[[dict], dict]):
+    def _wrap(method: str, fn: Callable[[dict], dict]):
         def handler(request_bytes: bytes, context) -> bytes:
+            t0 = time.monotonic()
             try:
                 request = cloudpickle.loads(request_bytes)
                 ctx = _prop.extract(request)
@@ -95,12 +105,22 @@ class RpcServer:
                     if ctx is not None
                     else contextlib.nullcontext()
                 )
-                with scope:
+                # A deadlocked handler is attributed by the watchdog as
+                # "rpc/handler" with the method name.
+                with scope, _watchdog.inflight("rpc/handler", method=method):
                     reply = fn(request)
+                _flight.record(
+                    "rpc", method, dir="recv",
+                    duration_s=round(time.monotonic() - t0, 6),
+                )
                 return cloudpickle.dumps({"ok": True, "value": reply})
             except Exception as exc:  # ship the error to the caller
                 import traceback
 
+                _flight.record(
+                    "rpc", method, dir="recv", status="error",
+                    error=f"{type(exc).__name__}: {exc}"[:200],
+                )
                 return cloudpickle.dumps(
                     {
                         "ok": False,
@@ -146,11 +166,31 @@ class RpcClient:
                     response_deserializer=_identity,
                 )
                 self._stubs[method] = stub
-        reply_bytes = stub(
-            cloudpickle.dumps(_prop.inject(request or {})),
-            timeout=timeout if timeout is not None else self._timeout,
+        qualified = f"{self._service}.{method}"
+        t0 = time.monotonic()
+        token = _watchdog.tracker.begin(
+            "rpc", method=qualified, peer=self.address
         )
+        try:
+            reply_bytes = stub(
+                cloudpickle.dumps(_prop.inject(request or {})),
+                timeout=timeout if timeout is not None else self._timeout,
+            )
+        except Exception as exc:
+            _flight.record(
+                "rpc", qualified, dir="send", peer=self.address,
+                status="transport-error",
+                error=f"{type(exc).__name__}"[:200],
+            )
+            raise
+        finally:
+            _watchdog.tracker.end(token)
         reply = cloudpickle.loads(reply_bytes)
+        _flight.record(
+            "rpc", qualified, dir="send", peer=self.address,
+            duration_s=round(time.monotonic() - t0, 6),
+            **({} if reply.get("ok") else {"status": "remote-error"}),
+        )
         if not reply.get("ok"):
             raise RpcError(
                 f"remote {self._service}.{method} failed: "

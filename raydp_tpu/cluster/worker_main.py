@@ -27,7 +27,10 @@ import cloudpickle
 from raydp_tpu.cluster.rpc import RpcClient, RpcServer
 from raydp_tpu.store.object_store import ObjectStore
 from raydp_tpu.telemetry import MetricsShipper, flush_spans, span
+from raydp_tpu.telemetry import flight_recorder as _flight
+from raydp_tpu.telemetry import logs as _logs
 from raydp_tpu.telemetry import propagation as trace_prop
+from raydp_tpu.telemetry import watchdog as _watchdog
 from raydp_tpu.utils.profiling import metrics
 
 logger = logging.getLogger(__name__)
@@ -175,13 +178,18 @@ class Worker:
             args = req.get("args", ())
             kwargs = req.get("kwargs", {})
             metrics.counter_add("worker/tasks")
+            _flight.record("task", "start", worker_id=self.worker_id)
             # RpcServer already installed the caller's traceparent as
             # this handler thread's ambient context, so this span — and
             # any span the task body opens — lands in the driver's
-            # job trace, under the submitting stage span.
-            with span("worker/task", worker_id=self.worker_id):
-                with metrics.timer("worker/task").time():
-                    result = fn(self.ctx, *args, **kwargs)
+            # job trace, under the submitting stage span. The inflight
+            # bracket is the watchdog's stall signal: a wedged task
+            # body shows up as component "worker/task".
+            with _watchdog.inflight("worker/task", worker_id=self.worker_id):
+                with span("worker/task", worker_id=self.worker_id):
+                    with metrics.timer("worker/task").time():
+                        result = fn(self.ctx, *args, **kwargs)
+            _flight.record("task", "end", worker_id=self.worker_id)
             return {"result": result}
         except Exception:
             # Let RpcServer._wrap serialize the failure uniformly.
@@ -197,14 +205,48 @@ class Worker:
         self._stop_event.set()
         return {"stopping": True}
 
+    def _serve_debug(self):
+        """Per-worker /healthz + /debug endpoints when
+        RAYDP_TPU_DEBUG_PORT is set (0 = ephemeral, logged). The wedged
+        process answering 503 here while /metrics keeps serving is the
+        per-process face of the health plane."""
+        from raydp_tpu.telemetry import (
+            DEBUG_PORT_ENV,
+            render_prometheus,
+            serve_prometheus,
+        )
+
+        port = os.environ.get(DEBUG_PORT_ENV)
+        if port is None:
+            return None
+        try:
+            return serve_prometheus(
+                lambda: render_prometheus(
+                    {"workers": {self.worker_id: metrics.snapshot()}}
+                ),
+                int(port),
+            )
+        except Exception:
+            logger.exception("worker debug endpoint failed to start")
+            return None
+
     def run(self) -> None:
         self.register()
+        _flight.record("state", "registered", worker_id=self.worker_id)
+        debug_server = self._serve_debug()
         missed = 0
         while not self._stop_event.wait(2.0):
             beat = {"worker_id": self.worker_id}
             delta = self._shipper.delta()
             if delta:
                 beat["metrics"] = delta
+            # Ship stall flags so the master's health_report() names
+            # this worker and the stuck component while the task RPC is
+            # still open (long before any heartbeat timeout: a wedged
+            # task does not stop THIS thread).
+            health = _watchdog.health()
+            if not health.get("healthy", True):
+                beat["health"] = {"stalls": health.get("stalls", {})}
             reply = self.master.try_call("Heartbeat", beat, timeout=8.0)
             # Shard spans continuously (no-op without a telemetry dir):
             # the driver's live trace_report() sees worker spans at
@@ -213,6 +255,7 @@ class Worker:
             with self._busy_lock:
                 busy = self._busy > 0
             if reply is None:
+                _flight.record("heartbeat", "missed", missed=missed + 1)
                 # Failed beats must not eat their metrics delta: re-ship
                 # the sections on the next beat.
                 self._shipper.rollback(delta)
@@ -267,9 +310,12 @@ class Worker:
             {"worker_id": self.worker_id, "metrics": self._shipper.full()},
             timeout=2.0,
         )
+        _flight.record("state", "stopping", worker_id=self.worker_id)
         # Tail spans of a clean exit (the atexit hook is a backstop for
         # paths that bypass run(), e.g. a registration failure).
         flush_spans()
+        if debug_server is not None:
+            debug_server.close()
         self._server.stop()
 
 
@@ -291,6 +337,11 @@ def main(argv=None) -> int:
     # env) before any span is recorded; flush tail spans on interpreter
     # exit so clean shutdowns never lose the last buffer.
     trace_prop.adopt_env_context()
+    # Health plane: black box (crash/SIGTERM postmortem bundles),
+    # trace-stamped JSONL logs, and the progress watchdog.
+    _flight.install(component="worker")
+    _logs.install()
+    _watchdog.ensure_started()
     atexit.register(flush_spans)
     worker = Worker(
         args.worker_id,

@@ -33,6 +33,8 @@ import numpy as np
 
 from raydp_tpu.native import lib as native
 from raydp_tpu.telemetry import current_context, propagated, span
+from raydp_tpu.telemetry import flight_recorder as _flight
+from raydp_tpu.telemetry import watchdog as _watchdog
 from raydp_tpu.utils.profiling import metrics
 
 # Auto transfer-chunk sizing: coalesce batches until a chunk reaches this
@@ -203,7 +205,13 @@ class JaxShardLoader:
             # The span closes before the yield: a suspended generator must
             # not hold an open span on this thread's stack while consumer
             # code (steps, other chunks) runs and parents under it.
-            with span("ingest/chunk", epoch=epoch, rank=self._rank,
+            # Same close-before-yield rule for the watchdog bracket: an
+            # in-flight op must cover only the gather, not the
+            # generator's suspension (which can legitimately last a full
+            # step and would read as an ingest stall).
+            with _watchdog.inflight("ingest/chunk", epoch=epoch,
+                                    rank=self._rank), \
+                 span("ingest/chunk", epoch=epoch, rank=self._rank,
                       rows=hi - lo):
                 if order is None:
                     # Sequential epoch: zero-copy row-slice views.
@@ -217,6 +225,8 @@ class JaxShardLoader:
                 bytes_meter.add(
                     x.nbytes + (y.nbytes if y is not None else 0)
                 )
+            _flight.record("loader", "chunk", epoch=epoch, rank=self._rank,
+                           rows=hi - lo)
             yield x, y
 
     def _epoch_iter(self, epoch: int):
@@ -237,8 +247,12 @@ class JaxShardLoader:
         def put_chunk(chunk):
             x, y = chunk
             if device is not None:
-                x = jax.device_put(x, device)
-                y = jax.device_put(y, device) if y is not None else None
+                # Bracketed: a host→device transfer that never completes
+                # (remote-TPU link wedge) is a classic silent hang.
+                with _watchdog.inflight("ingest/device_put",
+                                        rank=self._rank):
+                    x = jax.device_put(x, device)
+                    y = jax.device_put(y, device) if y is not None else None
             return x, y
 
         def batches_of(chunk):

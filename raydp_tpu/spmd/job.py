@@ -35,6 +35,8 @@ import cloudpickle
 
 from raydp_tpu.cluster.rpc import RpcClient, RpcServer
 from raydp_tpu.telemetry import ClusterTelemetry, span
+from raydp_tpu.telemetry import flight_recorder as _flight
+from raydp_tpu.telemetry import watchdog as _watchdog
 from raydp_tpu.utils.net import find_free_port
 
 logger = logging.getLogger(__name__)
@@ -168,6 +170,8 @@ class SPMDJob:
         # Per-rank metrics merged from heartbeat-shipped deltas; survives
         # gang restarts (ranks keep their keys across incarnations).
         self.telemetry = ClusterTelemetry()
+        # Watchdog stall flags shipped on rank Pings (empty = healthy).
+        self._rank_health: Dict[str, dict] = {}
 
     def rank_nodes(self) -> List[str]:
         """Node (host) of every rank — ranks fill hosts in order,
@@ -359,6 +363,8 @@ class SPMDJob:
 
     def _fail(self, reason: str) -> None:
         self._failed = reason
+        _flight.record("error", "spmd_fail", job=self.job_name,
+                       reason=str(reason)[:200])
         logger.warning("SPMD job %s failed: %s", self.job_name, reason)
         self._register_barrier.set()  # wake a start() still waiting
         inflight = self._inflight
@@ -387,14 +393,34 @@ class SPMDJob:
         return {}
 
     def _on_ping(self, req: dict) -> dict:
+        rank_key = f"rank-{req.get('rank', '?')}"
         delta = req.get("metrics")
         if delta:
-            self.telemetry.apply(f"rank-{req.get('rank', '?')}", delta)
+            self.telemetry.apply(rank_key, delta)
+        # Unconditional: a beat without a health payload means the
+        # rank's watchdog sees no stall (recovery clears the flag).
+        self._rank_health[rank_key] = (
+            (req.get("health") or {}).get("stalls") or {}
+        )
         return {"pong": True, "gen": self._gen}
 
     def metrics_snapshot(self) -> dict:
         """Merged per-rank metrics view (heartbeat-shipped deltas)."""
         return self.telemetry.merged()
+
+    def health_report(self) -> dict:
+        """Gang health: per-rank stall flags shipped on Pings, plus job
+        failure state (parity with ``Cluster.health_report``)."""
+        ranks = {rid: dict(stalls) for rid, stalls in
+                 sorted(self._rank_health.items())}
+        stalled = sorted(rid for rid, stalls in ranks.items() if stalls)
+        return {
+            "healthy": not stalled and not self._failed,
+            "ranks": ranks,
+            "stalled_ranks": stalled,
+            "failed": self._failed,
+            "world_size": self.world_size,
+        }
 
     # -------------------------------------------------------------------- run
 
@@ -421,8 +447,16 @@ class SPMDJob:
             )
         with self._lock:
             self._func_id += 1
-            with span("spmd/dispatch", job=self.job_name,
-                      func_id=self._func_id, world_size=self.world_size):
+            _flight.record("dispatch", "start", job=self.job_name,
+                           func_id=self._func_id)
+            # A gang that never reports back (rank wedged in a
+            # collective) is attributed as "spmd/dispatch" on the driver
+            # — pair it with health_report()'s per-rank flags to see
+            # WHICH rank.
+            with _watchdog.inflight(
+                "spmd/dispatch", job=self.job_name, func_id=self._func_id
+            ), span("spmd/dispatch", job=self.job_name,
+                    func_id=self._func_id, world_size=self.world_size):
                 results = _FuncResults(self._func_id, self.world_size)
                 self._inflight = results
                 fn_blob = cloudpickle.dumps(fn)

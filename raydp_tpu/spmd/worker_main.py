@@ -36,7 +36,10 @@ from raydp_tpu.spmd.job import (
     WORKER_SERVICE,
 )
 from raydp_tpu.telemetry import MetricsShipper, flush_spans, span
+from raydp_tpu.telemetry import flight_recorder as _flight
+from raydp_tpu.telemetry import logs as _logs
 from raydp_tpu.telemetry import propagation as trace_prop
+from raydp_tpu.telemetry import watchdog as _watchdog
 from raydp_tpu.utils.net import local_ip
 
 logger = logging.getLogger(__name__)
@@ -138,7 +141,13 @@ class SPMDWorker:
                 if ctx is not None
                 else contextlib.nullcontext()
             )
-            with scope, span(
+            _flight.record("func", "start", rank=self.rank,
+                           func_id=func_id)
+            # A wedged shipped function (collective waiting on a dead
+            # peer is the classic) is attributed as "spmd/func".
+            with scope, _watchdog.inflight(
+                "spmd/func", rank=self.rank, func_id=func_id
+            ), span(
                 "spmd/func", rank=self.rank, func_id=func_id
             ) as sp:
                 try:
@@ -152,6 +161,9 @@ class SPMDWorker:
                 except Exception:
                     error = traceback.format_exc()
                     sp.status = "error"
+            _flight.record("func", "end", rank=self.rank,
+                           func_id=func_id,
+                           **({"status": "error"} if error else {}))
             reply = self.driver.try_call(
                 "FuncResult",
                 {
@@ -186,10 +198,17 @@ class SPMDWorker:
             delta = shipper.delta()
             if delta:
                 beat["metrics"] = delta
+            # Stall flags ride the Ping: the driver's
+            # SPMDJob.health_report() names this rank and the stuck
+            # component while the function is still "running".
+            health = _watchdog.health()
+            if not health.get("healthy", True):
+                beat["health"] = {"stalls": health.get("stalls", {})}
             # Shard this rank's spans continuously (no-op without a
             # telemetry dir) so a driver-side trace_report sees them live.
             flush_spans()
             if self.driver.try_call("Ping", beat, timeout=5.0) is None:
+                _flight.record("heartbeat", "missed", missed=missed + 1)
                 shipper.rollback(delta)  # re-ship the delta next beat
                 missed += 1
                 if missed >= 3:
@@ -203,6 +222,30 @@ class SPMDWorker:
             else:
                 missed = 0
 
+    def _serve_debug(self):
+        """Per-rank /healthz + /debug endpoints when
+        RAYDP_TPU_DEBUG_PORT is set (0 = ephemeral, logged)."""
+        from raydp_tpu.telemetry import (
+            DEBUG_PORT_ENV,
+            render_prometheus,
+            serve_prometheus,
+        )
+        from raydp_tpu.utils.profiling import metrics
+
+        port = os.environ.get(DEBUG_PORT_ENV)
+        if port is None:
+            return None
+        try:
+            return serve_prometheus(
+                lambda: render_prometheus(
+                    {"workers": {f"rank-{self.rank}": metrics.snapshot()}}
+                ),
+                int(port),
+            )
+        except Exception:
+            logger.exception("rank debug endpoint failed to start")
+            return None
+
     def run(self) -> int:
         self.driver.call(
             "RegisterWorker",
@@ -213,12 +256,17 @@ class SPMDWorker:
                 "pid": os.getpid(),
             },
         )
+        _flight.record("state", "registered", rank=self.rank)
+        debug_server = self._serve_debug()
         runner = threading.Thread(target=self._runner, daemon=True)
         runner.start()
         threading.Thread(target=self._heartbeat, daemon=True).start()
         self._stop_event.wait()
         runner.join(timeout=2.0)
+        _flight.record("state", "stopping", rank=self.rank)
         flush_spans()  # tail spans of a clean stop (atexit is backstop)
+        if debug_server is not None:
+            debug_server.close()
         self._server.stop()
         self.driver.close()
         return 0
@@ -232,6 +280,11 @@ def main() -> int:
     # Join the driver's job trace before any span is recorded; flush
     # tail spans on interpreter exit.
     trace_prop.adopt_env_context()
+    # Health plane: crash/SIGTERM postmortem bundles, trace-stamped
+    # JSONL logs, progress watchdog.
+    _flight.install(component="spmd-worker")
+    _logs.install()
+    _watchdog.ensure_started()
     atexit.register(flush_spans)
     try:
         return SPMDWorker().run()

@@ -27,6 +27,14 @@ without pulling in jax):
   (``python -m raydp_tpu.telemetry.analyze <dir>`` or
   ``Cluster.trace_report()``).
 
+* :mod:`~raydp_tpu.telemetry.watchdog` /
+  :mod:`~raydp_tpu.telemetry.flight_recorder` /
+  :mod:`~raydp_tpu.telemetry.logs` — the health plane: in-flight-op
+  stall detection shipped to ``Cluster.health_report()`` and served at
+  ``/healthz``, a per-process crash flight recorder that dumps
+  postmortem bundles (event tail + all-thread stacks), and
+  trace-stamped JSONL structured logs.
+
 Drivers pull the live aggregate with ``Cluster.metrics_snapshot()``
 (works identically through ``raydp_tpu.connect`` client sessions).
 See ``doc/telemetry.md``.
@@ -37,6 +45,7 @@ from raydp_tpu.telemetry.chrome_trace import (
     write_chrome_trace,
 )
 from raydp_tpu.telemetry.export import (
+    DEBUG_PORT_ENV,
     METRICS_PORT_ENV,
     TELEMETRY_DIR_ENV,
     flush_spans,
@@ -45,6 +54,14 @@ from raydp_tpu.telemetry.export import (
     telemetry_dir,
     write_events,
 )
+from raydp_tpu.telemetry import flight_recorder, logs, watchdog
+from raydp_tpu.telemetry.flight_recorder import (
+    POSTMORTEM_DIR_ENV,
+    dump_bundle,
+    latest_bundle,
+    postmortem_dir,
+)
+from raydp_tpu.telemetry.watchdog import Watchdog, inflight
 from raydp_tpu.telemetry.propagation import (
     TRACEPARENT_ENV,
     TraceContext,
@@ -72,7 +89,17 @@ __all__ = [
     "ClusterTelemetry",
     "TELEMETRY_DIR_ENV",
     "METRICS_PORT_ENV",
+    "DEBUG_PORT_ENV",
+    "POSTMORTEM_DIR_ENV",
     "TRACEPARENT_ENV",
+    "flight_recorder",
+    "logs",
+    "watchdog",
+    "Watchdog",
+    "inflight",
+    "dump_bundle",
+    "latest_bundle",
+    "postmortem_dir",
     "telemetry_dir",
     "flush_spans",
     "write_events",

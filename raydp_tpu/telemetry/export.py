@@ -25,13 +25,21 @@ environment variable (inherited by worker subprocesses, so every
 process of a job logs under one directory) or passed explicitly.
 :func:`serve_prometheus` exposes the exposition over a tiny stdlib
 HTTP endpoint for in-cluster scrapes (the k8s manifests annotate pods
-with ``prometheus.io/scrape`` pointing at it).
+with ``prometheus.io/scrape`` pointing at it) — and doubles as the
+per-process **debug server**: ``/healthz`` (200/503 from the local
+watchdog state, the k8s probe target), ``/debug/state`` (JSON health +
+flight-recorder tail + metrics snapshot), ``/debug/stacks``
+(all-thread dump). Pass ``port=0`` for an ephemeral port (reported on
+the handle and in the startup log line) so several processes on one
+host never collide on ``RAYDP_TPU_METRICS_PORT``.
 """
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
+import time
 from typing import Any, Callable, Dict, Iterable, List, Optional
 
 from raydp_tpu.telemetry import spans as _spans
@@ -39,6 +47,7 @@ from raydp_tpu.telemetry import spans as _spans
 __all__ = [
     "TELEMETRY_DIR_ENV",
     "METRICS_PORT_ENV",
+    "DEBUG_PORT_ENV",
     "telemetry_dir",
     "append_jsonl",
     "flush_spans",
@@ -49,6 +58,11 @@ __all__ = [
 
 TELEMETRY_DIR_ENV = "RAYDP_TPU_TELEMETRY_DIR"
 METRICS_PORT_ENV = "RAYDP_TPU_METRICS_PORT"
+# Worker processes serve their own /healthz + /debug endpoints on this
+# port when set. Use 0 for an ephemeral port (many workers per host).
+DEBUG_PORT_ENV = "RAYDP_TPU_DEBUG_PORT"
+
+logger = logging.getLogger(__name__)
 
 _write_mu = threading.Lock()
 
@@ -185,6 +199,11 @@ def render_prometheus(view: Dict[str, Any]) -> str:
         "Spans evicted from a process's ring buffer before any flush "
         "drained them (raise RAYDP_TPU_SPAN_BUFFER or flush more often).",
     )
+    stalls = _Family(
+        "raydp_stalls_total", "counter",
+        "Watchdog-detected stall episodes: a component's oldest "
+        "in-flight op exceeded RAYDP_TPU_WATCHDOG_STALL_S.",
+    )
 
     sources: Dict[str, Dict[str, Any]] = dict(view.get("workers") or {})
     driver = view.get("driver")
@@ -210,6 +229,12 @@ def render_prometheus(view: Dict[str, Any]) -> str:
                         # target it without label matching.
                         dropped.add({"worker": worker_id}, section[name])
                         continue
+                    if name == "watchdog/stalls":
+                        # Same operability treatment as span loss: a
+                        # dedicated family so "any rank stalled" is one
+                        # alert expression.
+                        stalls.add({"worker": worker_id}, section[name])
+                        continue
                     counters.add(
                         {"worker": worker_id, "name": name}, section[name]
                     )
@@ -228,7 +253,8 @@ def render_prometheus(view: Dict[str, Any]) -> str:
                 timers.add(labels, section.get("count", 0.0), suffix="_count")
 
     lines: List[str] = []
-    for family in (up, counters, meter_total, meter_rate, timers, dropped):
+    for family in (up, counters, meter_total, meter_rate, timers, dropped,
+                   stalls):
         lines.extend(family.render())
     return "\n".join(lines) + ("\n" if lines else "")
 
@@ -242,40 +268,113 @@ class _ScrapeServer:
     def __init__(self, httpd, thread):
         self._httpd = httpd
         self._thread = thread
+        self._closed = False
+        self._close_mu = threading.Lock()
         self.port = httpd.server_address[1]
 
     def close(self) -> None:
+        # Idempotent: both Cluster.shutdown() and atexit paths may call
+        # this, and http.server raises on double server_close().
+        with self._close_mu:
+            if self._closed:
+                return
+            self._closed = True
         self._httpd.shutdown()
         self._httpd.server_close()
         self._thread.join(timeout=2.0)
 
 
+def _default_health() -> Dict[str, Any]:
+    from raydp_tpu.telemetry import watchdog as _watchdog
+
+    return _watchdog.health()
+
+
+def _debug_state(health: Callable[[], Dict[str, Any]]) -> Dict[str, Any]:
+    from raydp_tpu.telemetry import flight_recorder as _flight
+    from raydp_tpu.utils.profiling import metrics as _metrics
+
+    return {
+        "pid": os.getpid(),
+        "wall_time": time.time(),
+        "component": _flight.installed_component(),
+        "health": health(),
+        "flight": _flight.recorder.tail(100),
+        "metrics": _metrics.snapshot(),
+    }
+
+
 def serve_prometheus(
-    render: Callable[[], str], port: int, host: str = "0.0.0.0"
+    render: Callable[[], str],
+    port: int,
+    host: str = "0.0.0.0",
+    health: Optional[Callable[[], Dict[str, Any]]] = None,
 ) -> _ScrapeServer:
-    """Serve ``render()`` (exposition text) at ``/metrics`` on a daemon
-    thread — the in-cluster scrape target the k8s manifests annotate.
-    Stdlib ``http.server`` only: one scrape every few seconds, no need
-    for more. Returns a handle with ``.port`` and ``.close()``."""
+    """Serve the process debug surface on a daemon thread.
+
+    Routes: ``/metrics`` (``render()`` exposition text — the scrape
+    target the k8s manifests annotate), ``/healthz`` (JSON from
+    ``health()`` — default: the local watchdog — with status 503 when
+    unhealthy, so it plugs straight into k8s probes), ``/debug/state``
+    (health + flight-recorder tail + metrics snapshot), and
+    ``/debug/stacks`` (plain-text all-thread dump). Stdlib
+    ``http.server`` only: one scrape every few seconds, no need for
+    more. ``port=0`` binds an ephemeral port. Returns a handle with
+    ``.port`` and idempotent ``.close()``."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+    health_fn = health if health is not None else _default_health
+
     class Handler(BaseHTTPRequestHandler):
-        def do_GET(self):  # noqa: N802 - http.server API
-            if self.path.split("?")[0] not in ("/metrics", "/"):
-                self.send_error(404)
-                return
-            try:
-                body = render().encode("utf-8")
-            except Exception as exc:  # render must not kill the endpoint
-                self.send_error(500, str(exc))
-                return
-            self.send_response(200)
-            self.send_header(
-                "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
-            )
+        def _reply(self, code: int, body: bytes, ctype: str) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 - http.server API
+            path = self.path.split("?")[0]
+            try:
+                if path in ("/metrics", "/"):
+                    self._reply(
+                        200, render().encode("utf-8"),
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                elif path == "/healthz":
+                    state = health_fn()
+                    code = 200 if state.get("healthy", True) else 503
+                    self._reply(
+                        code,
+                        json.dumps(state, default=str).encode("utf-8"),
+                        "application/json",
+                    )
+                elif path == "/debug/state":
+                    self._reply(
+                        200,
+                        json.dumps(
+                            _debug_state(health_fn), default=str
+                        ).encode("utf-8"),
+                        "application/json",
+                    )
+                elif path == "/debug/stacks":
+                    from raydp_tpu.telemetry import flight_recorder as _fl
+
+                    text = "\n".join(
+                        f"--- thread {label} ---\n{stack}"
+                        for label, stack in _fl.all_thread_stacks().items()
+                    )
+                    self._reply(
+                        200, text.encode("utf-8"),
+                        "text/plain; charset=utf-8",
+                    )
+                else:
+                    self.send_error(404)
+            except Exception as exc:  # a route must not kill the endpoint
+                try:
+                    self.send_error(500, str(exc))
+                except Exception:
+                    pass
 
         def log_message(self, *args):  # silence per-scrape stderr noise
             pass
@@ -285,4 +384,11 @@ def serve_prometheus(
         target=httpd.serve_forever, name="raydp-metrics-http", daemon=True
     )
     thread.start()
-    return _ScrapeServer(httpd, thread)
+    server = _ScrapeServer(httpd, thread)
+    # port=0 callers learn the ephemeral port here (and via .port).
+    logger.info(
+        "telemetry debug endpoint on %s:%d "
+        "(/metrics /healthz /debug/state /debug/stacks)",
+        host, server.port,
+    )
+    return server

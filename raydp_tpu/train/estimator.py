@@ -27,6 +27,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from raydp_tpu.data.ml_dataset import MLDataset
 from raydp_tpu.parallel.mesh import MeshSpec
 from raydp_tpu.telemetry import flush_spans, span
+from raydp_tpu.telemetry import flight_recorder as _flight
+from raydp_tpu.telemetry import watchdog as _watchdog
 from raydp_tpu.train.losses import resolve_loss, resolve_metric
 
 logger = logging.getLogger(__name__)
@@ -581,10 +583,17 @@ class JAXEstimator:
             # DISPATCH time (async jax: the device may still be computing)
             # — steady-state it converges to true step time because the
             # pipeline is throughput-bound, and compile steps stand out.
+            _flight.record("train", "epoch_start", epoch=epoch,
+                           mode="stream")
             with span("train/epoch", epoch=epoch, mode="stream"):
                 for xd, yd, blen in self._sharded_prefetch(host_batches()):
                     rng, step_rng = jax.random.split(rng)
-                    with span("train/step", epoch=epoch, step=b_idx) as sp:
+                    # Watchdog bracket = step boundary: a dispatch that
+                    # never returns (device wedge, collective hang) is
+                    # attributed as "train/step" with the exact step.
+                    with _watchdog.inflight("train/step", epoch=epoch,
+                                            step=b_idx), \
+                         span("train/step", epoch=epoch, step=b_idx) as sp:
                         while True:
                             try:
                                 self._state, loss_val = self._train_step(
@@ -800,7 +809,13 @@ class JAXEstimator:
         for epoch in range(epochs):
             t0 = time.perf_counter()
             rng, key = jax.random.split(rng)
-            with span("train/epoch", epoch=epoch, mode="scan",
+            _flight.record("train", "epoch_start", epoch=epoch,
+                           mode="scan", n_steps=n_steps)
+            # Scan mode fuses the epoch into one dispatch, so the whole
+            # epoch is the watchdog's progress unit.
+            with _watchdog.inflight("train/epoch", epoch=epoch,
+                                    mode="scan"), \
+                 span("train/epoch", epoch=epoch, mode="scan",
                       n_steps=n_steps):
                 while True:
                     try:

@@ -11,9 +11,18 @@ cd "$(dirname "$0")/.."
 
 LOG="${T1_LOG:-/tmp/_t1.log}"
 rm -f "$LOG"
+# Crash black box for CI: every test-spawned process dumps a postmortem
+# bundle here on crash/SIGTERM/watchdog stall; shipped on failure below.
+export RAYDP_TPU_POSTMORTEM_DIR="${RAYDP_TPU_POSTMORTEM_DIR:-/tmp/raydp_tpu_postmortem.$$}"
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
   -m 'not slow' --continue-on-collection-errors \
   -p no:cacheprovider -p no:xdist -p no:randomly "$@" 2>&1 | tee "$LOG"
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)
+if [ "$rc" -ne 0 ]; then
+  # Ship the black box with the failure: newest bundle's reason + last
+  # flight events (no-op message when nothing crashed).
+  echo "--- newest postmortem bundle (if any) ---"
+  python -m raydp_tpu.telemetry.flight_recorder "$RAYDP_TPU_POSTMORTEM_DIR" || true
+fi
 exit $rc
