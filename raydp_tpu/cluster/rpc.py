@@ -41,6 +41,11 @@ def _identity(b: bytes) -> bytes:
     return b
 
 
+# Handler methods that run user code and so legitimately outlive the
+# default stall threshold; everything else is control-plane and fast.
+_LONG_HANDLER_METHODS = frozenset({"RunTask", "RunFunction"})
+
+
 class RpcError(RuntimeError):
     """Remote handler raised; message carries the remote traceback."""
 
@@ -106,8 +111,18 @@ class RpcServer:
                     else contextlib.nullcontext()
                 )
                 # A deadlocked handler is attributed by the watchdog as
-                # "rpc/handler" with the method name.
-                with scope, _watchdog.inflight("rpc/handler", method=method):
+                # "rpc/handler" with the method name. Methods that run
+                # user code (a whole task body / shipped function) are
+                # expected to take long — their threshold is raised so a
+                # healthy 5-minute task is not reported as a wedge;
+                # control-plane handlers keep the sharp default.
+                stall_s = (
+                    _watchdog.long_stall_s()
+                    if method in _LONG_HANDLER_METHODS else None
+                )
+                with scope, _watchdog.inflight(
+                    "rpc/handler", method=method, stall_after_s=stall_s
+                ):
                     reply = fn(request)
                 _flight.record(
                     "rpc", method, dir="recv",
@@ -168,13 +183,23 @@ class RpcClient:
                 self._stubs[method] = stub
         qualified = f"{self._service}.{method}"
         t0 = time.monotonic()
+        # The op can legitimately stay in flight until the RPC deadline
+        # (grpc fails it then, ending the bracket) — so the stall
+        # threshold follows the deadline instead of crying wolf at the
+        # default 60s. Deadline-less stubs (SPMD control channels) fall
+        # back to the long-op threshold.
+        eff_timeout = timeout if timeout is not None else self._timeout
         token = _watchdog.tracker.begin(
-            "rpc", method=qualified, peer=self.address
+            "rpc", method=qualified, peer=self.address,
+            stall_after_s=(
+                eff_timeout if eff_timeout is not None
+                else _watchdog.long_stall_s()
+            ),
         )
         try:
             reply_bytes = stub(
                 cloudpickle.dumps(_prop.inject(request or {})),
-                timeout=timeout if timeout is not None else self._timeout,
+                timeout=eff_timeout,
             )
         except Exception as exc:
             _flight.record(

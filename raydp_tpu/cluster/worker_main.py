@@ -184,8 +184,12 @@ class Worker:
             # any span the task body opens — lands in the driver's
             # job trace, under the submitting stage span. The inflight
             # bracket is the watchdog's stall signal: a wedged task
-            # body shows up as component "worker/task".
-            with _watchdog.inflight("worker/task", worker_id=self.worker_id):
+            # body shows up as component "worker/task" — at the long-op
+            # threshold, since a healthy task may run for minutes.
+            with _watchdog.inflight(
+                "worker/task", worker_id=self.worker_id,
+                stall_after_s=_watchdog.long_stall_s(),
+            ):
                 with span("worker/task", worker_id=self.worker_id):
                     with metrics.timer("worker/task").time():
                         result = fn(self.ctx, *args, **kwargs)

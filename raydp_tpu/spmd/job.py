@@ -171,6 +171,10 @@ class SPMDJob:
         # gang restarts (ranks keep their keys across incarnations).
         self.telemetry = ClusterTelemetry()
         # Watchdog stall flags shipped on rank Pings (empty = healthy).
+        # Guarded by its own lock, NOT self._lock: run() holds _lock for
+        # the whole dispatch (minutes), and Ping handlers must never
+        # block behind it.
+        self._health_lock = threading.Lock()
         self._rank_health: Dict[str, dict] = {}
 
     def rank_nodes(self) -> List[str]:
@@ -399,9 +403,10 @@ class SPMDJob:
             self.telemetry.apply(rank_key, delta)
         # Unconditional: a beat without a health payload means the
         # rank's watchdog sees no stall (recovery clears the flag).
-        self._rank_health[rank_key] = (
-            (req.get("health") or {}).get("stalls") or {}
-        )
+        with self._health_lock:
+            self._rank_health[rank_key] = (
+                (req.get("health") or {}).get("stalls") or {}
+            )
         return {"pong": True, "gen": self._gen}
 
     def metrics_snapshot(self) -> dict:
@@ -411,8 +416,10 @@ class SPMDJob:
     def health_report(self) -> dict:
         """Gang health: per-rank stall flags shipped on Pings, plus job
         failure state (parity with ``Cluster.health_report``)."""
+        with self._health_lock:  # Pings insert keys concurrently
+            snapshot = dict(self._rank_health)
         ranks = {rid: dict(stalls) for rid, stalls in
-                 sorted(self._rank_health.items())}
+                 sorted(snapshot.items())}
         stalled = sorted(rid for rid, stalls in ranks.items() if stalls)
         return {
             "healthy": not stalled and not self._failed,
@@ -452,9 +459,11 @@ class SPMDJob:
             # A gang that never reports back (rank wedged in a
             # collective) is attributed as "spmd/dispatch" on the driver
             # — pair it with health_report()'s per-rank flags to see
-            # WHICH rank.
+            # WHICH rank. The dispatch legitimately runs until its own
+            # deadline, so the stall threshold is raised to match it.
             with _watchdog.inflight(
-                "spmd/dispatch", job=self.job_name, func_id=self._func_id
+                "spmd/dispatch", job=self.job_name, func_id=self._func_id,
+                stall_after_s=timeout or max(self.timeout, 60.0),
             ), span("spmd/dispatch", job=self.job_name,
                     func_id=self._func_id, world_size=self.world_size):
                 results = _FuncResults(self._func_id, self.world_size)

@@ -144,9 +144,13 @@ class SPMDWorker:
             _flight.record("func", "start", rank=self.rank,
                            func_id=func_id)
             # A wedged shipped function (collective waiting on a dead
-            # peer is the classic) is attributed as "spmd/func".
+            # peer is the classic) is attributed as "spmd/func" — at the
+            # long-op threshold: a shipped function is often a whole
+            # training loop, and healthy minutes-long runs must not
+            # read as stalls.
             with scope, _watchdog.inflight(
-                "spmd/func", rank=self.rank, func_id=func_id
+                "spmd/func", rank=self.rank, func_id=func_id,
+                stall_after_s=_watchdog.long_stall_s(),
             ), span(
                 "spmd/func", rank=self.rank, func_id=func_id
             ) as sp:

@@ -26,8 +26,10 @@ process of a job logs under one directory) or passed explicitly.
 :func:`serve_prometheus` exposes the exposition over a tiny stdlib
 HTTP endpoint for in-cluster scrapes (the k8s manifests annotate pods
 with ``prometheus.io/scrape`` pointing at it) — and doubles as the
-per-process **debug server**: ``/healthz`` (200/503 from the local
-watchdog state, the k8s probe target), ``/debug/state`` (JSON health +
+per-process **debug server**: ``/livez`` (pure responsiveness, always
+200 — the k8s *liveness* target, because a watchdog stall can be a
+legitimately long op), ``/healthz`` (200/503 from the local watchdog
+state, the *readiness* target), ``/debug/state`` (JSON health +
 flight-recorder tail + metrics snapshot), ``/debug/stacks``
 (all-thread dump). Pass ``port=0`` for an ephemeral port (reported on
 the handle and in the startup log line) so several processes on one
@@ -313,13 +315,16 @@ def serve_prometheus(
     """Serve the process debug surface on a daemon thread.
 
     Routes: ``/metrics`` (``render()`` exposition text — the scrape
-    target the k8s manifests annotate), ``/healthz`` (JSON from
-    ``health()`` — default: the local watchdog — with status 503 when
-    unhealthy, so it plugs straight into k8s probes), ``/debug/state``
-    (health + flight-recorder tail + metrics snapshot), and
-    ``/debug/stacks`` (plain-text all-thread dump). Stdlib
-    ``http.server`` only: one scrape every few seconds, no need for
-    more. ``port=0`` binds an ephemeral port. Returns a handle with
+    target the k8s manifests annotate), ``/livez`` (always 200 while
+    the process can answer HTTP at all — the k8s *liveness* target;
+    stall state must not feed liveness, because a stalled op may be a
+    healthy long compile/epoch and kubelet would kill a working pod),
+    ``/healthz`` (JSON from ``health()`` — default: the local watchdog
+    — with status 503 when unhealthy, the k8s *readiness* target),
+    ``/debug/state`` (health + flight-recorder tail + metrics
+    snapshot), and ``/debug/stacks`` (plain-text all-thread dump).
+    Stdlib ``http.server`` only: one scrape every few seconds, no need
+    for more. ``port=0`` binds an ephemeral port. Returns a handle with
     ``.port`` and idempotent ``.close()``."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -340,6 +345,17 @@ def serve_prometheus(
                     self._reply(
                         200, render().encode("utf-8"),
                         "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                elif path == "/livez":
+                    # Pure responsiveness: reaching this line IS the
+                    # check. No watchdog state — liveness restarts must
+                    # target wedged processes, not slow-but-healthy ops.
+                    self._reply(
+                        200,
+                        json.dumps(
+                            {"alive": True, "pid": os.getpid()}
+                        ).encode("utf-8"),
+                        "application/json",
                     )
                 elif path == "/healthz":
                     state = health_fn()
@@ -388,7 +404,7 @@ def serve_prometheus(
     # port=0 callers learn the ephemeral port here (and via .port).
     logger.info(
         "telemetry debug endpoint on %s:%d "
-        "(/metrics /healthz /debug/state /debug/stacks)",
+        "(/metrics /livez /healthz /debug/state /debug/stacks)",
         host, server.port,
     )
     return server

@@ -18,13 +18,22 @@ the process.
   ``crash-<pid>.txt`` sidecar for SIGSEGV/SIGABRT-class deaths that
   never reach Python, plus a SIGTERM handler (``signals=True`` only)
   that dumps a bundle and then re-raises the default disposition so a
-  ``kubectl delete`` / launcher kill still terminates the process;
+  ``kubectl delete`` / launcher kill still terminates the process.
+  The handler runs on the main thread, possibly interrupting a frame
+  that holds the ring or metrics locks, so the whole SIGTERM path is
+  **lock-free**: the ring is snapshotted with a try-acquire (CPython
+  deque ops are atomic, the lock only makes snapshots consistent) and
+  the metrics snapshot — whose registry lock we cannot try-acquire —
+  is skipped;
 * watchdog escalation (:mod:`~raydp_tpu.telemetry.watchdog` calls
-  :func:`dump_bundle` on a new stall episode).
+  :func:`dump_bundle` on a new stall episode, rate-limited per
+  component).
 
 Bundles land in ``RAYDP_TPU_POSTMORTEM_DIR`` (default:
 ``<telemetry_dir>/postmortem``; disabled when neither is set) as
-``postmortem-<pid>-<seq>.json``. ``python -m
+``postmortem-<pid>-<seq>.json``; the directory is capped at
+``RAYDP_TPU_POSTMORTEM_KEEP`` bundles (default 20, oldest deleted
+first) so a long-running pod cannot fill its node disk. ``python -m
 raydp_tpu.telemetry.flight_recorder [DIR]`` prints the newest bundle's
 reason and event tail — scripts/verify.sh ships it on CI failures.
 """
@@ -32,6 +41,7 @@ from __future__ import annotations
 
 import collections
 import faulthandler
+import itertools
 import json
 import os
 import signal
@@ -45,6 +55,7 @@ from raydp_tpu.telemetry.export import telemetry_dir
 
 __all__ = [
     "POSTMORTEM_DIR_ENV",
+    "POSTMORTEM_KEEP_ENV",
     "FLIGHT_EVENTS_ENV",
     "FlightRecorder",
     "recorder",
@@ -58,10 +69,12 @@ __all__ = [
 ]
 
 POSTMORTEM_DIR_ENV = "RAYDP_TPU_POSTMORTEM_DIR"
+POSTMORTEM_KEEP_ENV = "RAYDP_TPU_POSTMORTEM_KEEP"
 FLIGHT_EVENTS_ENV = "RAYDP_TPU_FLIGHT_EVENTS"
 BUNDLE_SCHEMA = "raydp-postmortem-v1"
 
 _DEFAULT_CAPACITY = 512
+_DEFAULT_KEEP = 20
 
 
 def _capacity() -> int:
@@ -69,6 +82,13 @@ def _capacity() -> int:
         return max(16, int(os.environ.get(FLIGHT_EVENTS_ENV, "")))
     except ValueError:
         return _DEFAULT_CAPACITY
+
+
+def _keep() -> int:
+    try:
+        return max(1, int(os.environ.get(POSTMORTEM_KEEP_ENV, "")))
+    except ValueError:
+        return _DEFAULT_KEEP
 
 
 class FlightRecorder:
@@ -82,10 +102,8 @@ class FlightRecorder:
         )
         self._mu = threading.Lock()
 
-    def record(self, kind: str, name: str, **attrs: Any) -> None:
-        """Append one event. ``kind`` is a coarse category (``state``,
-        ``rpc``, ``train``, ``loader``, ``watchdog``, ``log``,
-        ``error``); ``name`` identifies the event within it."""
+    @staticmethod
+    def _event(kind: str, name: str, attrs: Dict[str, Any]) -> Dict[str, Any]:
         evt = {
             "wall": time.time(),
             "mono": time.monotonic(),
@@ -95,12 +113,49 @@ class FlightRecorder:
         }
         if attrs:
             evt["attrs"] = attrs
+        return evt
+
+    def record(self, kind: str, name: str, **attrs: Any) -> None:
+        """Append one event. ``kind`` is a coarse category (``state``,
+        ``rpc``, ``train``, ``loader``, ``watchdog``, ``log``,
+        ``error``); ``name`` identifies the event within it."""
+        evt = self._event(kind, name, attrs)
         with self._mu:
             self._ring.append(evt)
 
-    def tail(self, n: Optional[int] = None) -> List[Dict[str, Any]]:
-        with self._mu:
-            events = list(self._ring)
+    def record_nowait(self, kind: str, name: str, **attrs: Any) -> None:
+        """Signal-safe append: never blocks on the ring lock. A signal
+        handler can interrupt the very frame that holds ``_mu``; deque
+        appends are atomic in CPython, so when the try-acquire fails we
+        append without the lock rather than deadlock."""
+        evt = self._event(kind, name, attrs)
+        if self._mu.acquire(blocking=False):
+            try:
+                self._ring.append(evt)
+            finally:
+                self._mu.release()
+        else:
+            self._ring.append(evt)
+
+    def tail(self, n: Optional[int] = None,
+             blocking: bool = True) -> List[Dict[str, Any]]:
+        """Snapshot of the ring, oldest first. ``blocking=False`` is the
+        signal-safe variant: if the lock is unavailable (possibly held
+        by the interrupted frame itself) the ring is copied without it,
+        retrying on a concurrent-mutation race."""
+        if self._mu.acquire(blocking=blocking):
+            try:
+                events = list(self._ring)
+            finally:
+                self._mu.release()
+        else:
+            events = []
+            for _ in range(3):
+                try:
+                    events = list(self._ring)
+                    break
+                except RuntimeError:  # deque mutated mid-copy
+                    continue
         return events if n is None else events[-n:]
 
     def clear(self) -> None:
@@ -118,7 +173,9 @@ record = recorder.record
 _install_mu = threading.Lock()
 _installed_component: Optional[str] = None
 _fault_file = None  # keep the fd alive; faulthandler writes to it on crash
-_bundle_seq = 0
+# itertools.count: atomic under the GIL, so bundle sequence numbers
+# need no lock — dump_bundle must stay callable from signal handlers.
+_bundle_seq = itertools.count(1)
 _prev_excepthook = None
 _prev_threading_hook = None
 
@@ -152,12 +209,55 @@ def _metrics_snapshot() -> Dict[str, Any]:
         return {}
 
 
+def _prune_bundles(directory: str, keep: int) -> None:
+    """Delete the oldest ``postmortem-*.json`` beyond ``keep`` — the
+    disk-bound on flapping dumpers. Lock-free and per-file best-effort
+    (several processes may prune one shared directory concurrently)."""
+    try:
+        bundles = [
+            os.path.join(directory, f)
+            for f in os.listdir(directory)
+            if f.startswith("postmortem-") and f.endswith(".json")
+        ]
+        if len(bundles) <= keep:
+            return
+        bundles.sort(key=_bundle_age_key)
+        for path in bundles[:-keep]:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+    except OSError:
+        pass
+
+
+def _bundle_age_key(path: str) -> tuple:
+    # mtime first; the numeric <seq> breaks same-mtime ties (bundles
+    # written back-to-back by one process) so "oldest" is well-defined.
+    name = os.path.basename(path)
+    try:
+        seq = int(name.rsplit("-", 1)[1].split(".", 1)[0])
+    except (IndexError, ValueError):
+        seq = 0
+    try:
+        mtime = os.path.getmtime(path)
+    except OSError:
+        mtime = 0.0
+    return (mtime, seq)
+
+
 def dump_bundle(reason: str, *, exc: Optional[BaseException] = None,
-                directory: Optional[str] = None) -> Optional[str]:
+                directory: Optional[str] = None,
+                signal_safe: bool = False) -> Optional[str]:
     """Write a postmortem bundle; returns its path (None when no bundle
     directory is configured). Never raises — this runs from excepthooks
-    and signal handlers, where a second failure would mask the first."""
-    global _bundle_seq
+    and signal handlers, where a second failure would mask the first.
+
+    ``signal_safe=True`` (the SIGTERM handler) must not block on any
+    non-reentrant lock the interrupted frame may hold: the ring is
+    snapshotted with a try-acquire and the metrics snapshot (registry
+    lock) is skipped.
+    """
     try:
         directory = directory or postmortem_dir()
         if not directory:
@@ -173,25 +273,23 @@ def dump_bundle(reason: str, *, exc: Optional[BaseException] = None,
             "pid": os.getpid(),
             "argv": list(sys.argv),
             "traceparent": _prop.to_traceparent(ctx) if ctx else None,
-            "events": recorder.tail(),
+            "events": recorder.tail(blocking=not signal_safe),
             "stacks": all_thread_stacks(),
-            "metrics": _metrics_snapshot(),
+            "metrics": {} if signal_safe else _metrics_snapshot(),
         }
         if exc is not None:
             bundle["exception"] = "".join(
                 traceback.format_exception(type(exc), exc, exc.__traceback__)
             )
-        with _install_mu:
-            _bundle_seq += 1
-            seq = _bundle_seq
         path = os.path.join(
-            directory, f"postmortem-{os.getpid()}-{seq}.json"
+            directory, f"postmortem-{os.getpid()}-{next(_bundle_seq)}.json"
         )
         os.makedirs(directory, exist_ok=True)
         tmp = path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump(bundle, f, default=str)
         os.replace(tmp, path)
+        _prune_bundles(directory, _keep())
         return path
     except Exception:
         return None
@@ -220,8 +318,12 @@ def _threading_hook(args):
 
 
 def _sigterm_handler(signum, frame):
-    record("state", "sigterm")
-    dump_bundle("SIGTERM")
+    # Runs on the main thread and may interrupt a frame that holds the
+    # ring/metrics locks — everything here must be non-blocking, or the
+    # process wedges inside the handler until SIGKILL and loses both
+    # the bundle and its termination grace period.
+    recorder.record_nowait("state", "sigterm")
+    dump_bundle("SIGTERM", signal_safe=True)
     # Restore the default disposition and re-deliver so the sender's
     # kill semantics (exit status, process-group teardown) still hold.
     signal.signal(signal.SIGTERM, signal.SIG_DFL)

@@ -73,13 +73,23 @@ class JsonLogHandler(logging.Handler):
 
 _mu = threading.Lock()
 _handler: Optional[JsonLogHandler] = None
+_prev_root_level: Optional[int] = None
 
 
 def install(directory: Optional[str] = None,
             level: int = logging.INFO) -> Optional[JsonLogHandler]:
     """Attach the JSONL handler to the root logger. Idempotent; returns
-    the handler, or None when no telemetry directory is configured."""
-    global _handler
+    the handler, or None when no telemetry directory is configured.
+
+    Handler levels filter *after* the logger's own level: in a process
+    that never configured logging, the root logger's default WARNING
+    would silently drop INFO records before they reach the handler. So
+    the root level is lowered to ``level`` when it would filter more
+    than the handler does (and restored on :func:`uninstall`). Console
+    output is unaffected — the app's own handlers and logging's
+    last-resort handler keep their own levels.
+    """
+    global _handler, _prev_root_level
     directory = directory or telemetry_dir()
     if not directory:
         return None
@@ -89,17 +99,24 @@ def install(directory: Optional[str] = None,
         path = os.path.join(directory, f"logs-{os.getpid()}.jsonl")
         handler = JsonLogHandler(path)
         handler.setLevel(level)
-        logging.getLogger().addHandler(handler)
+        root = logging.getLogger()
+        root.addHandler(handler)
+        if root.getEffectiveLevel() > level:
+            _prev_root_level = root.level
+            root.setLevel(level)
         _handler = handler
         return handler
 
 
 def uninstall() -> None:
-    global _handler
+    global _handler, _prev_root_level
     with _mu:
         if _handler is not None:
             logging.getLogger().removeHandler(_handler)
             _handler = None
+        if _prev_root_level is not None:
+            logging.getLogger().setLevel(_prev_root_level)
+            _prev_root_level = None
 
 
 def read_records(directory: Optional[str] = None) -> List[Dict[str, Any]]:

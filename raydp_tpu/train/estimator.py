@@ -591,9 +591,13 @@ class JAXEstimator:
                     # Watchdog bracket = step boundary: a dispatch that
                     # never returns (device wedge, collective hang) is
                     # attributed as "train/step" with the exact step.
-                    with _watchdog.inflight("train/step", epoch=epoch,
-                                            step=b_idx), \
-                         span("train/step", epoch=epoch, step=b_idx) as sp:
+                    # Step 0 JIT-compiles and routinely exceeds the
+                    # default stall threshold, so it gets the long one.
+                    with _watchdog.inflight(
+                        "train/step", epoch=epoch, step=b_idx,
+                        stall_after_s=(_watchdog.long_stall_s()
+                                       if b_idx == 0 else None),
+                    ), span("train/step", epoch=epoch, step=b_idx) as sp:
                         while True:
                             try:
                                 self._state, loss_val = self._train_step(
@@ -812,9 +816,11 @@ class JAXEstimator:
             _flight.record("train", "epoch_start", epoch=epoch,
                            mode="scan", n_steps=n_steps)
             # Scan mode fuses the epoch into one dispatch, so the whole
-            # epoch is the watchdog's progress unit.
+            # epoch is the watchdog's progress unit — long-op threshold:
+            # a healthy epoch dwarfs the per-step stall default.
             with _watchdog.inflight("train/epoch", epoch=epoch,
-                                    mode="scan"), \
+                                    mode="scan",
+                                    stall_after_s=_watchdog.long_stall_s()), \
                  span("train/epoch", epoch=epoch, mode="scan",
                       n_steps=n_steps):
                 while True:

@@ -128,6 +128,43 @@ def test_watchdog_new_component_is_a_fresh_episode():
     assert wd.check()["healthy"] is True
 
 
+def test_per_op_stall_threshold_raises_never_lowers():
+    pt = watchdog.ProgressTracker()
+    wd = watchdog.Watchdog(progress=pt, interval_s=999.0,
+                           stall_after_s=0.02, dump_bundles=False)
+    # An expected-long bracket (whole task body, first-step compile)
+    # raises its own threshold: not a stall at the global one.
+    long_op = pt.begin("worker/task", stall_after_s=60.0)
+    # An override BELOW the global threshold must not sharpen it.
+    short_op = pt.begin("rpc", stall_after_s=0.001)
+    time.sleep(0.05)
+    health = wd.check()
+    assert "worker/task" not in health["stalls"]
+    assert "rpc" in health["stalls"]
+    pt.end(long_op)
+    pt.end(short_op)
+    assert wd.check()["healthy"] is True
+
+
+def test_watchdog_flapping_component_dumps_one_bundle_per_cooldown(
+    tmp_path, monkeypatch
+):
+    monkeypatch.setenv(flight_recorder.POSTMORTEM_DIR_ENV, str(tmp_path))
+    pt = watchdog.ProgressTracker()
+    wd = watchdog.Watchdog(progress=pt, interval_s=999.0,
+                           stall_after_s=0.01, bundle_cooldown_s=3600.0)
+    # Flap: stall → recover → stall again, three episodes back-to-back.
+    for _ in range(3):
+        token = pt.begin("spmd/func")
+        time.sleep(0.02)
+        wd.check()
+        pt.end(token)
+        wd.check()
+    bundles = [f for f in os.listdir(tmp_path)
+               if f.startswith("postmortem-")]
+    assert len(bundles) == 1  # rate-limited, not one per flap
+
+
 def test_module_health_live_when_no_watchdog_running(monkeypatch):
     monkeypatch.setattr(watchdog, "_watchdog", None)
     monkeypatch.setenv(watchdog.WATCHDOG_STALL_ENV, "3600")
@@ -249,6 +286,57 @@ def test_sigterm_subprocess_dumps_bundle_then_dies_by_signal(tmp_path):
     assert any("MainThread" in label for label in bundle["stacks"])
 
 
+def test_postmortem_dir_is_capped_oldest_deleted_first(
+    tmp_path, monkeypatch
+):
+    monkeypatch.setenv(flight_recorder.POSTMORTEM_KEEP_ENV, "3")
+    paths = [
+        flight_recorder.dump_bundle(f"retention-{i}",
+                                    directory=str(tmp_path))
+        for i in range(6)
+    ]
+    assert all(paths)
+    kept = {f for f in os.listdir(tmp_path) if f.endswith(".json")}
+    assert len(kept) == 3
+    assert {os.path.basename(p) for p in paths[-3:]} == kept
+
+
+_SIGTERM_LOCKED_SCRIPT = textwrap.dedent("""\
+    import time
+
+    from raydp_tpu.telemetry import flight_recorder as fr
+
+    fr.install(component="worker")
+    fr.record("task", "start", worker_id="w9")
+    # SIGTERM interrupting the exact frame that holds the ring lock
+    # (the heartbeat loop records constantly): the handler must stay
+    # lock-free or the process wedges inside it until SIGKILL.
+    fr.recorder._mu.acquire()
+    print("READY", flush=True)
+    time.sleep(60)
+""")
+
+
+def test_sigterm_while_main_thread_holds_ring_lock_still_dumps(tmp_path):
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _SIGTERM_LOCKED_SCRIPT],
+        env=_child_env(tmp_path), stdout=subprocess.PIPE, text=True,
+    )
+    try:
+        assert proc.stdout.readline().strip() == "READY"
+        proc.terminate()
+        rc = proc.wait(timeout=30)  # deadlock ⇒ TimeoutExpired here
+    finally:
+        proc.kill()
+    assert rc == -signal.SIGTERM
+    path = flight_recorder.latest_bundle(str(tmp_path))
+    assert path is not None
+    bundle = flight_recorder.read_bundle(path)
+    assert bundle["reason"] == "SIGTERM"
+    assert any(e["name"] == "sigterm" for e in bundle["events"])
+    assert any("MainThread" in label for label in bundle["stacks"])
+
+
 def test_flight_recorder_cli(tmp_path, capsys):
     assert flight_recorder.main([str(tmp_path)]) == 0
     assert "no postmortem bundles" in capsys.readouterr().out
@@ -289,6 +377,26 @@ def test_log_inside_span_carries_trace_id(tmp_path):
         and e.get("attrs", {}).get("message") == "warned inside the span"
         for e in flight_recorder.recorder.tail()
     )
+
+
+def test_logs_install_captures_info_with_unconfigured_root(tmp_path):
+    # A process that never configured logging has the root logger at
+    # WARNING: without install() lowering it, INFO records would be
+    # filtered at the logger and never reach the JSONL handler.
+    root = logging.getLogger()
+    prev = root.level
+    root.setLevel(logging.WARNING)
+    try:
+        assert logs.install(directory=str(tmp_path)) is not None
+        log = logging.getLogger("raydp_tpu.tests.rootlevel")  # NOTSET
+        log.info("info reaches the shard")
+        logs.uninstall()
+        assert root.level == logging.WARNING  # uninstall restored it
+        msgs = [r["message"] for r in logs.read_records(str(tmp_path))]
+        assert "info reaches the shard" in msgs
+    finally:
+        logs.uninstall()
+        root.setLevel(prev)
 
 
 def test_logs_install_is_idempotent_and_noop_without_dir(tmp_path, monkeypatch):
@@ -344,6 +452,9 @@ def test_debug_server_routes_and_healthz_flip():
         code, body = _get(base + "/healthz")
         assert code == 200 and json.loads(body)["healthy"] is True
 
+        code, body = _get(base + "/livez")
+        assert code == 200 and json.loads(body)["alive"] is True
+
         # Wedge: /healthz flips 503 while /metrics keeps serving.
         state["healthy"] = False
         state["stalls"] = {"train/step": {"age_s": 99.0}}
@@ -352,6 +463,10 @@ def test_debug_server_routes_and_healthz_flip():
         assert json.loads(body)["stalls"]["train/step"]["age_s"] == 99.0
         code, _ = _get(base + "/metrics")
         assert code == 200
+        # /livez is the liveness target precisely because it ignores
+        # stall state: a long-but-healthy op must not get the pod killed.
+        code, body = _get(base + "/livez")
+        assert code == 200 and json.loads(body)["alive"] is True
 
         code, body = _get(base + "/debug/state")
         assert code == 200
@@ -385,6 +500,10 @@ def test_acceptance_wedged_worker_health_report_healthz_and_postmortem(
     # merges os.environ into worker subprocess envs, so the knobs reach
     # every rank. DEBUG_PORT=0: each worker logs its ephemeral port.
     monkeypatch.setenv(watchdog.WATCHDOG_STALL_ENV, "1")
+    # worker/task is a whole-body bracket and uses the LONG threshold
+    # (a healthy task may run for minutes); tighten it too so the wedge
+    # fires in seconds.
+    monkeypatch.setenv(watchdog.WATCHDOG_LONG_STALL_ENV, "1")
     monkeypatch.setenv(watchdog.WATCHDOG_INTERVAL_ENV, "0.2")
     monkeypatch.setenv(flight_recorder.POSTMORTEM_DIR_ENV, str(postmortem))
     monkeypatch.setenv("RAYDP_TPU_DEBUG_PORT", "0")
