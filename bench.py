@@ -785,7 +785,8 @@ def bench_ingest():
 
     # Both transfer modes (r4 verdict #3): per-batch device_puts pay a
     # device-link round trip per batch; coalesced mode amortizes it over
-    # ~32MB chunks with a multi-chunk in-flight window.
+    # ~128MB chunks (RAYDP_TRANSFER_CHUNK_MB) with a multi-chunk
+    # in-flight window, features+labels packed into one transfer each.
     micro = timed_epoch(1)
     ours = timed_epoch(None)  # auto-coalesced — the default path
 
@@ -1265,6 +1266,106 @@ def bench_etl_window():
     }
 
 
+def bench_dataplane():
+    """Data-plane microbenchmarks behind the r06 zero-copy work: scatter
+    bandwidth with control-plane envelope bytes alongside (proof the
+    tables ride shm, not RPC), stage dispatch latency at one-RPC-per-task
+    vs one-RunTaskBatch-per-worker, and packed-loader chunk rate."""
+    import jax
+    import pandas as pd
+    import pyarrow as pa
+
+    import raydp_tpu
+    import raydp_tpu.dataframe as rdf
+    from raydp_tpu.cluster.cluster import TaskSpec
+    from raydp_tpu.data.ml_dataset import MLDataset
+    from raydp_tpu.utils.profiling import metrics
+
+    def _payload() -> float:
+        return metrics.snapshot()["counters"].get("rpc/payload_bytes", 0.0)
+
+    n_rows, n_parts = 2_000_000, 16
+    rng = np.random.RandomState(13)
+    pdf = pd.DataFrame(
+        {f"f{i}": rng.randn(n_rows).astype(np.float32) for i in range(8)}
+    )
+    nbytes = int(pa.Table.from_pandas(pdf).nbytes)
+    out = {}
+    session = raydp_tpu.init(app_name="bench-dataplane", num_workers=4)
+    try:
+        # --- scatter: driver tables → worker-held refs ----------------
+        rdf.from_pandas(pdf, num_partitions=n_parts).count()  # warm
+        scatter_gbps, envelope = 0.0, float("inf")
+        for _ in range(3):
+            p0 = _payload()
+            t0 = time.perf_counter()
+            df = rdf.from_pandas(pdf, num_partitions=n_parts)
+            refs = df.to_object_refs()
+            dt = time.perf_counter() - t0
+            scatter_gbps = max(scatter_gbps, nbytes / dt / 1e9)
+            envelope = min(envelope, _payload() - p0)
+        out["scatter_gbps"] = round(scatter_gbps, 3)
+        out["scatter_bytes"] = nbytes
+        # Control-plane bytes for the whole scatter: O(refs), not
+        # O(table) — the before/after this section exists to record.
+        out["scatter_envelope_bytes"] = int(envelope)
+
+        # --- dispatch latency: per-task RPCs vs one batch per worker --
+        def noop(t):
+            return t
+
+        def task(ctx, ref):
+            ctx.get_table(ref)
+            return None
+
+        ex = df._executor
+        ex.map_partitions(refs, noop)  # warm worker pools
+        per_task = batched = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            for f in [
+                session.cluster.submit_async(task, r, worker_id=None)
+                for r in refs
+            ]:
+                f.result(timeout=120)
+            per_task = min(per_task, time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            for f in session.cluster.submit_batch(
+                [TaskSpec(task, (r,)) for r in refs]
+            ):
+                f.result(timeout=120)
+            batched = min(batched, time.perf_counter() - t0)
+        out["dispatch_ms_per_task_rpc"] = round(per_task * 1e3, 2)
+        out["dispatch_ms_batched_rpc"] = round(batched * 1e3, 2)
+        out["dispatch_speedup"] = round(per_task / batched, 2)
+    finally:
+        raydp_tpu.stop()
+
+    # --- packed single-transfer loader ---------------------------------
+    cols = {f"f{i}": rng.rand(500_000).astype(np.float32) for i in range(16)}
+    cols["y"] = rng.rand(500_000).astype(np.float32)
+    ds = MLDataset([pa.table(cols)], num_shards=1)
+    loader = ds.to_jax(
+        feature_columns=[f"f{i}" for i in range(16)],
+        label_column="y",
+        batch_size=65_536,
+        shuffle=False,
+        device=jax.devices()[0],
+    )
+    for _ in loader:  # warm
+        pass
+    c0 = metrics.snapshot()["counters"].get("ingest/device_puts", 0.0)
+    t0 = time.perf_counter()
+    for _ in loader:
+        pass
+    dt = time.perf_counter() - t0
+    chunks = metrics.snapshot()["counters"].get("ingest/device_puts", 0.0) - c0
+    out["loader_chunks_per_sec"] = round(chunks / dt, 2)
+    out["loader_device_puts_per_epoch"] = int(chunks)
+    out["unit"] = "GB/s scatter; ms dispatch; chunks/s loader"
+    return out
+
+
 # ----------------------------------------------------------- main
 
 # The CPU matrix runs in THIS process (pinned to the CPU platform —
@@ -1275,6 +1376,9 @@ CPU_MATRIX = [
     ("nyctaxi_mlp", bench_nyctaxi),
     ("etl_groupby_shuffle", bench_etl_groupby),
     ("etl_window", bench_etl_window),
+    # Host-side like the ETL configs: cluster + loader mechanics, no
+    # device math — full size even in CPU-fallback mode.
+    ("dataplane", bench_dataplane),
     # Ingest is bandwidth-sensitive: keep it ahead of the model configs
     # that leave host-memory pressure behind.
     ("ingest_device_feed", bench_ingest),

@@ -227,13 +227,22 @@ class RemoteCluster:
         worker_id: Optional[str] = None,
         timeout: float = 300.0,
         retries: int = 2,
+        data_args=(),
         **kwargs,
     ) -> Future:
+        """Like ``Cluster.submit_async``; ``data_args`` tables are staged
+        into the cluster's driver-node store via PutObject (a client has
+        no shm of its own — one hop to the master, after which workers
+        resolve them through the normal data plane) and only refs ride
+        the per-task envelope."""
+        staged = self._stage_data_args(data_args)
         payload = {
             "fn": cloudpickle.dumps(fn),
             "args": args,
             "kwargs": kwargs,
         }
+        if staged:
+            payload["data_refs"] = staged
         # Capture the submitting thread's trace context — the RPC fires
         # from a pool thread (same reasoning as Cluster.submit_async).
         from raydp_tpu.telemetry import propagation as _prop
@@ -281,10 +290,155 @@ class RemoteCluster:
             ) from last
 
         def traced_run():
-            with _prop.propagated(trace_ctx):
-                return run()
+            try:
+                with _prop.propagated(trace_ctx):
+                    return run()
+            finally:
+                self._discard_staged(staged)
 
         return self._pool.submit(traced_run)
+
+    # -- batched submission (one envelope per worker) --------------------
+    def submit_batch(self, specs, timeout: float = 300.0,
+                     retries: int = 2) -> List[Future]:
+        """Client-mode twin of ``Cluster.submit_batch``: one RunTaskBatch
+        envelope per worker, one Future per spec (in order)."""
+        futures: List[Future] = [Future() for _ in specs]
+        if not specs:
+            return futures
+        from raydp_tpu.telemetry import propagation as _prop
+
+        trace_ctx = _prop.current_context()
+
+        def orchestrate():
+            with _prop.propagated(trace_ctx):
+                try:
+                    self._run_batch(list(specs), futures, timeout, retries)
+                except BaseException as exc:  # noqa: BLE001
+                    for f in futures:
+                        if not f.done():
+                            f.set_exception(exc)
+
+        self._pool.submit(orchestrate)
+        return futures
+
+    def _run_batch(self, specs, futures, timeout, retries):
+        import grpc
+
+        staged = [self._stage_data_args(s.data_args) for s in specs]
+        try:
+            pending = list(range(len(specs)))
+            last: Optional[BaseException] = None
+            for attempt in range(retries + 1):
+                workers = self.alive_workers()
+                if not workers:
+                    last = ClientError("no alive workers")
+                    time.sleep(0.3 * (attempt + 1))
+                    continue
+                by_id = {w.worker_id: w for w in workers}
+                groups: Dict[str, List[int]] = {}
+                for i in pending:
+                    pref = specs[i].worker_id if attempt == 0 else None
+                    if pref not in by_id:
+                        pref = workers[
+                            (next(self._rr)) % len(workers)
+                        ].worker_id
+                    groups.setdefault(pref, []).append(i)
+                results: Dict[str, Any] = {}
+
+                def call_group(wid, idxs):
+                    try:
+                        client = self._worker_client(by_id[wid])
+                        fn_blobs, fn_index, tasks = [], {}, []
+                        for i in idxs:
+                            spec = specs[i]
+                            slot = fn_index.get(id(spec.fn))
+                            if slot is None:
+                                slot = len(fn_blobs)
+                                fn_blobs.append(cloudpickle.dumps(spec.fn))
+                                fn_index[id(spec.fn)] = slot
+                            task = {"fn": slot, "args": spec.args,
+                                    "kwargs": spec.kwargs}
+                            if staged[i]:
+                                task["data_refs"] = staged[i]
+                            tasks.append(task)
+                        reply = client.call(
+                            "RunTaskBatch",
+                            {"fns": fn_blobs, "tasks": tasks},
+                            timeout=timeout,
+                        )
+                        results[wid] = reply["results"]
+                    except grpc.RpcError as exc:
+                        if exc.code() in (grpc.StatusCode.UNAVAILABLE,
+                                          grpc.StatusCode.CANCELLED):
+                            with self._lock:
+                                self._workers_stamp = 0.0  # force refresh
+                            results[wid] = ClientError(
+                                f"worker {wid} unreachable"
+                            )
+                        else:
+                            results[wid] = ClientError(
+                                f"batch RPC to {wid} failed: {exc.code()}"
+                            )
+                            results[wid].__cause__ = exc
+                            results[wid]._hard = True
+                    except BaseException as exc:  # noqa: BLE001
+                        exc._hard = True
+                        results[wid] = exc
+
+                threads = [
+                    threading.Thread(target=call_group, args=(wid, idxs),
+                                     daemon=True)
+                    for wid, idxs in groups.items()
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                next_pending: List[int] = []
+                for wid, idxs in groups.items():
+                    outcome = results.get(wid)
+                    if isinstance(outcome, BaseException):
+                        if getattr(outcome, "_hard", False):
+                            raise outcome
+                        last = outcome
+                        next_pending.extend(idxs)
+                        continue
+                    for i, res in zip(idxs, outcome):
+                        if res.get("ok"):
+                            futures[i].set_result(res.get("value"))
+                        else:
+                            futures[i].set_exception(RpcError(
+                                f"batched task failed on {wid}: "
+                                f"{res.get('error')}\n"
+                                f"{res.get('traceback', '')}"
+                            ))
+                pending = next_pending
+                if not pending:
+                    return
+            for i in pending:
+                if not futures[i].done():
+                    futures[i].set_exception(ClientError(
+                        f"batched task failed after {retries + 1} "
+                        f"attempts: {last}"
+                    ))
+        finally:
+            for refs in staged:
+                self._discard_staged(refs)
+
+    # -- data-plane staging ----------------------------------------------
+    def _stage_data_args(self, tables) -> List[ObjectRef]:
+        if not tables:
+            return []
+        store = self.master.store
+        return [store.put_arrow_table(t) for t in tables]
+
+    def _discard_staged(self, refs) -> None:
+        for ref in refs or ():
+            try:
+                self.master.store.delete(ref)
+            except Exception:
+                pass
 
     def _worker_client(self, info: WorkerInfo) -> RpcClient:
         with self._lock:

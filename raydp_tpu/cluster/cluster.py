@@ -23,14 +23,15 @@ import subprocess
 import threading
 import time
 from concurrent.futures import Future, ThreadPoolExecutor
-from typing import Any, Callable, Dict, List, Optional
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import cloudpickle
 
 from raydp_tpu.cluster import placement as pl
 from raydp_tpu.cluster.launcher import LaunchSpec, LocalLauncher, WorkerLauncher
 from raydp_tpu.cluster.master import AppMaster, WorkerInfo
-from raydp_tpu.cluster.rpc import RpcClient
+from raydp_tpu.cluster.rpc import RpcClient, RpcError
 from raydp_tpu.config import ClusterConfig
 from raydp_tpu.store.object_store import DEFAULT_NODE
 
@@ -39,6 +40,27 @@ logger = logging.getLogger(__name__)
 
 class ClusterError(RuntimeError):
     pass
+
+
+@dataclass
+class TaskSpec:
+    """One task in a :meth:`Cluster.submit_batch` call.
+
+    ``data_args`` are Arrow tables that travel the DATA plane: they are
+    written to the submitter's shm store and only their ObjectRefs ride
+    the RPC envelope; the worker resolves them (zero-copy when
+    co-located) and appends the tables after ``args`` in the call.
+    """
+
+    fn: Callable
+    args: Tuple = ()
+    kwargs: Dict[str, Any] = field(default_factory=dict)
+    worker_id: Optional[str] = None  # locality preference, not a pin
+    data_args: Tuple = ()
+
+
+class _WorkerGone(Exception):
+    """Batch envelope lost to worker death; tasks are retriable."""
 
 
 class Cluster:
@@ -581,13 +603,26 @@ class Cluster:
         worker_id: Optional[str] = None,
         timeout: float = 300.0,
         retries: int = 2,
+        data_args: Sequence = (),
         **kwargs,
     ) -> Future:
+        """Run ``fn(worker_ctx, *args, *data_args, **kwargs)`` on a worker.
+
+        ``data_args`` (Arrow tables) move through the shm object store:
+        the tables are written into the driver's store here and only
+        their ObjectRefs are shipped in the RunTask envelope — a
+        co-located worker maps them zero-copy, a remote one streams them
+        from this node's agent in bounded chunks. The control-plane
+        payload stays O(refs) regardless of table size.
+        """
+        staged = self._stage_data_args(data_args)
         payload = {
             "fn": cloudpickle.dumps(fn),
             "args": args,
             "kwargs": kwargs,
         }
+        if staged:
+            payload["data_refs"] = staged
         # The RunTask RPC fires from a pool thread; capture the
         # SUBMITTING thread's trace context here so the worker-side task
         # span parents under e.g. the driver's df/stage span instead of
@@ -659,8 +694,14 @@ class Cluster:
             ) from last
 
         def traced_run():
-            with _prop.propagated(trace_ctx):
-                return run()
+            try:
+                with _prop.propagated(trace_ctx):
+                    return run()
+            finally:
+                # Staged data_args are scratch: the worker has consumed
+                # them (re-put under its own ownership where needed) by
+                # the time the RPC returns. Unlink keeps driver shm flat.
+                self._discard_staged(staged)
 
         return self._pool.submit(traced_run)
 
@@ -676,6 +717,205 @@ class Cluster:
             self.submit_async(fn, item, timeout=timeout) for item in items
         ]
         return [f.result() for f in futures]
+
+    # -- batched submission (one envelope per worker) ---------------------
+    def submit_batch(
+        self,
+        specs: Sequence[TaskSpec],
+        timeout: float = 300.0,
+        retries: int = 2,
+    ) -> List[Future]:
+        """Run many tasks with ONE RunTaskBatch envelope per worker.
+
+        Tasks are grouped by their (locality-preferred) target worker and
+        each group ships as a single RPC carrying all of that worker's
+        tasks — per-call gRPC + pickle overhead is paid once per worker
+        instead of once per partition. Each distinct ``fn`` is serialized
+        once per envelope. Returns one Future per spec, in order; a
+        future resolves as soon as its worker's envelope lands, so
+        callers can stream per-task completions (``add_done_callback``)
+        instead of waiting for the slowest worker.
+
+        Worker death fails only that worker's envelope; its tasks are
+        reassigned to surviving workers (stage tasks are idempotent),
+        up to ``retries`` rounds.
+        """
+        futures: List[Future] = [Future() for _ in specs]
+        if not specs:
+            return futures
+        from raydp_tpu.telemetry import propagation as _prop
+
+        trace_ctx = _prop.current_context()
+
+        def orchestrate():
+            with _prop.propagated(trace_ctx):
+                try:
+                    self._run_batch(list(specs), futures, timeout, retries)
+                except BaseException as exc:  # noqa: BLE001 - fan to futures
+                    for f in futures:
+                        if not f.done():
+                            f.set_exception(exc)
+
+        self._pool.submit(orchestrate)
+        return futures
+
+    def _run_batch(
+        self,
+        specs: List[TaskSpec],
+        futures: List[Future],
+        timeout: float,
+        retries: int,
+    ) -> None:
+        staged = [self._stage_data_args(s.data_args) for s in specs]
+        try:
+            pending = list(range(len(specs)))
+            last: Optional[BaseException] = None
+            for attempt in range(retries + 1):
+                groups: Dict[str, List[int]] = {}
+                try:
+                    for i in pending:
+                        pref = specs[i].worker_id if attempt == 0 else None
+                        try:
+                            target = self._pick_worker(pref)
+                        except ClusterError:
+                            if pref is None:
+                                raise
+                            target = self._pick_worker(None)
+                        groups.setdefault(target, []).append(i)
+                except ClusterError as exc:
+                    # No alive workers (elastic respawn may still be
+                    # bringing one back) — wait and retry the round.
+                    last = exc
+                    time.sleep(0.3 * (attempt + 1))
+                    continue
+                results: Dict[str, Any] = {}
+                threads = []
+                for wid, idxs in groups.items():
+                    t = threading.Thread(
+                        target=self._call_batch_into,
+                        args=(results, wid, idxs, specs, staged, timeout),
+                        name=f"raydp-batch-{wid}",
+                        daemon=True,
+                    )
+                    t.start()
+                    threads.append(t)
+                for t in threads:
+                    t.join()
+                next_pending: List[int] = []
+                for wid, idxs in groups.items():
+                    outcome = results.get(wid)
+                    if isinstance(outcome, _WorkerGone):
+                        last = ClusterError(str(outcome))
+                        next_pending.extend(idxs)
+                        continue
+                    if isinstance(outcome, BaseException):
+                        raise outcome
+                    for i, res in zip(idxs, outcome):
+                        if res.get("ok"):
+                            futures[i].set_result(res.get("value"))
+                        else:
+                            futures[i].set_exception(
+                                RpcError(
+                                    f"batched task failed on {wid}: "
+                                    f"{res.get('error')}\n"
+                                    f"{res.get('traceback', '')}"
+                                )
+                            )
+                pending = next_pending
+                if not pending:
+                    return
+            for i in pending:
+                if not futures[i].done():
+                    futures[i].set_exception(
+                        ClusterError(
+                            f"batched task failed after {retries + 1} "
+                            f"attempts: {last}"
+                        )
+                    )
+        finally:
+            for refs in staged:
+                self._discard_staged(refs)
+
+    def _call_batch_into(
+        self,
+        results: Dict[str, Any],
+        worker_id: str,
+        idxs: List[int],
+        specs: List[TaskSpec],
+        staged: List[List[Any]],
+        timeout: float,
+    ) -> None:
+        """One RunTaskBatch envelope to one worker; outcome (per-task
+        result list, _WorkerGone, or a hard error) lands in ``results``."""
+        import grpc
+
+        try:
+            client = self._client_for(worker_id)
+            if client is None:
+                raise _WorkerGone(f"worker {worker_id} is gone")
+            fn_blobs: List[bytes] = []
+            fn_index: Dict[int, int] = {}  # id(fn) -> slot, dedup per envelope
+            tasks = []
+            for i in idxs:
+                spec = specs[i]
+                slot = fn_index.get(id(spec.fn))
+                if slot is None:
+                    slot = len(fn_blobs)
+                    fn_blobs.append(cloudpickle.dumps(spec.fn))
+                    fn_index[id(spec.fn)] = slot
+                task = {"fn": slot, "args": spec.args, "kwargs": spec.kwargs}
+                if staged[i]:
+                    task["data_refs"] = staged[i]
+                tasks.append(task)
+            payload = {"fns": fn_blobs, "tasks": tasks}
+            try:
+                reply = client.call("RunTaskBatch", payload, timeout=timeout)
+            except grpc.RpcError as exc:
+                code = exc.code()
+                if self._elastic_stop.is_set():
+                    raise ClusterError(
+                        f"batch RPC to worker {worker_id} failed: {code} "
+                        "(cluster is shutting down)"
+                    ) from exc
+                # Same death taxonomy as submit_async: UNAVAILABLE /
+                # CANCELLED mean the worker is gone and the idempotent
+                # stage tasks may re-run elsewhere; anything else is a
+                # hard error.
+                if (
+                    code in (grpc.StatusCode.UNAVAILABLE,
+                             grpc.StatusCode.CANCELLED)
+                    and self.master is not None
+                ):
+                    self.master.mark_worker_dead(
+                        worker_id, reason="worker unreachable"
+                    )
+                    raise _WorkerGone(
+                        f"batch RPC to worker {worker_id} failed: {code}"
+                    ) from exc
+                raise ClusterError(
+                    f"batch RPC to worker {worker_id} failed: {code}"
+                ) from exc
+            results[worker_id] = reply["results"]
+        except BaseException as exc:  # noqa: BLE001 - marshalled to caller
+            results[worker_id] = exc
+
+    # -- data-plane staging ----------------------------------------------
+    def _stage_data_args(self, tables: Sequence) -> List[Any]:
+        """Write Arrow tables into the driver-node store; only the refs
+        ride the control plane."""
+        if not tables:
+            return []
+        store = self.master.store
+        return [store.put_arrow_table(t) for t in tables]
+
+    def _discard_staged(self, refs: Sequence) -> None:
+        if not refs or self.master is None:
+            return
+        for ref in refs:
+            try:
+                self.master.store.delete(ref)
+            except Exception:  # pragma: no cover - scratch cleanup
+                pass
 
     def _pick_worker(self, worker_id: Optional[str]) -> str:
         workers = self.alive_workers()

@@ -35,6 +35,7 @@ import grpc
 from raydp_tpu.telemetry import flight_recorder as _flight
 from raydp_tpu.telemetry import propagation as _prop
 from raydp_tpu.telemetry import watchdog as _watchdog
+from raydp_tpu.utils.profiling import metrics as _metrics
 
 
 def _identity(b: bytes) -> bytes:
@@ -43,7 +44,7 @@ def _identity(b: bytes) -> bytes:
 
 # Handler methods that run user code and so legitimately outlive the
 # default stall threshold; everything else is control-plane and fast.
-_LONG_HANDLER_METHODS = frozenset({"RunTask", "RunFunction"})
+_LONG_HANDLER_METHODS = frozenset({"RunTask", "RunTaskBatch", "RunFunction"})
 
 
 class RpcError(RuntimeError):
@@ -196,11 +197,14 @@ class RpcClient:
                 else _watchdog.long_stall_s()
             ),
         )
+        request_bytes = cloudpickle.dumps(_prop.inject(request or {}))
+        # Control-plane envelope size. Data is supposed to move through
+        # the shm object store, so a fat counter here means some path is
+        # smuggling table bytes through RPC (exported as
+        # raydp_rpc_payload_bytes; asserted small in tests).
+        _metrics.counter_add("rpc/payload_bytes", len(request_bytes))
         try:
-            reply_bytes = stub(
-                cloudpickle.dumps(_prop.inject(request or {})),
-                timeout=eff_timeout,
-            )
+            reply_bytes = stub(request_bytes, timeout=eff_timeout)
         except Exception as exc:
             _flight.record(
                 "rpc", qualified, dir="send", peer=self.address,

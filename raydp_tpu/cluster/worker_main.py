@@ -115,10 +115,17 @@ class Worker:
         # lists this worker ALIVE the moment RegisterWorker returns — so a
         # task can arrive while ctx is still being built. Gate on readiness.
         self._ready = threading.Event()
+        # Batched tasks run concurrently on this pool (pyarrow releases
+        # the GIL for the heavy kernels, so same-worker tasks in one
+        # envelope keep the intra-worker parallelism that per-partition
+        # RPCs used to get from separate gRPC handler threads).
+        self._task_pool = None
+        self._task_pool_lock = threading.Lock()
         self._server = RpcServer(
             WORKER_SERVICE,
             {
                 "RunTask": self._on_run_task,
+                "RunTaskBatch": self._on_run_task_batch,
                 "Ping": lambda req: {"pong": True, "worker_id": self.worker_id},
                 "Stop": self._on_stop,
             },
@@ -177,6 +184,10 @@ class Worker:
             fn = cloudpickle.loads(req["fn"])
             args = req.get("args", ())
             kwargs = req.get("kwargs", {})
+            # data_args travel the data plane: the envelope carries refs,
+            # the tables are resolved here (zero-copy from local shm when
+            # co-located with the submitter, chunked agent fetch if not).
+            data = self._resolve_data_refs(req.get("data_refs", ()))
             metrics.counter_add("worker/tasks")
             _flight.record("task", "start", worker_id=self.worker_id)
             # RpcServer already installed the caller's traceparent as
@@ -192,12 +203,85 @@ class Worker:
             ):
                 with span("worker/task", worker_id=self.worker_id):
                     with metrics.timer("worker/task").time():
-                        result = fn(self.ctx, *args, **kwargs)
+                        result = fn(self.ctx, *args, *data, **kwargs)
             _flight.record("task", "end", worker_id=self.worker_id)
             return {"result": result}
         except Exception:
             # Let RpcServer._wrap serialize the failure uniformly.
             raise
+        finally:
+            with self._busy_lock:
+                self._busy -= 1
+
+    def _resolve_data_refs(self, refs):
+        return [self.ctx.get_table(r) for r in refs]
+
+    def _pool(self):
+        with self._task_pool_lock:
+            if self._task_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._task_pool = ThreadPoolExecutor(
+                    max_workers=max(4, os.cpu_count() or 4),
+                    thread_name_prefix=f"{self.worker_id}-task",
+                )
+            return self._task_pool
+
+    def _on_run_task_batch(self, req: dict) -> dict:
+        """One envelope, many tasks (the driver's submit_batch).
+
+        Each distinct fn arrives once in ``fns``; tasks reference it by
+        slot. Tasks run concurrently on the worker task pool and each
+        reports per-task ``{"ok": ...}`` so one bad partition fails only
+        its own future, not its siblings in the envelope.
+        """
+        with self._busy_lock:
+            self._busy += 1
+        try:
+            if not self._ready.wait(timeout=15.0):
+                raise RuntimeError(
+                    "worker context not ready (registration hung)"
+                )
+            fns = [cloudpickle.loads(b) for b in req["fns"]]
+            tasks = req.get("tasks", ())
+            metrics.counter_add("worker/tasks", len(tasks))
+            metrics.counter_add("worker/task_batches")
+            _flight.record("task", "batch_start", worker_id=self.worker_id,
+                           tasks=len(tasks))
+            # Task-pool threads don't inherit this handler thread's
+            # propagated traceparent — re-propagate it so per-task spans
+            # still parent under the driver's stage span.
+            batch_ctx = trace_prop.current_context()
+
+            def run_one(task: dict) -> dict:
+                try:
+                    fn = fns[task["fn"]]
+                    args = task.get("args", ())
+                    kwargs = task.get("kwargs", {})
+                    data = self._resolve_data_refs(task.get("data_refs", ()))
+                    with trace_prop.propagated(batch_ctx):
+                        with span("worker/task", worker_id=self.worker_id):
+                            with metrics.timer("worker/task").time():
+                                value = fn(self.ctx, *args, *data, **kwargs)
+                    return {"ok": True, "value": value}
+                except Exception as exc:
+                    return {
+                        "ok": False,
+                        "error": f"{type(exc).__name__}: {exc}",
+                        "traceback": traceback.format_exc(),
+                    }
+
+            with _watchdog.inflight(
+                "worker/task", worker_id=self.worker_id,
+                stall_after_s=_watchdog.long_stall_s(),
+            ):
+                if len(tasks) == 1:
+                    results = [run_one(tasks[0])]
+                else:
+                    results = list(self._pool().map(run_one, tasks))
+            _flight.record("task", "batch_end", worker_id=self.worker_id,
+                           tasks=len(tasks))
+            return {"results": results}
         finally:
             with self._busy_lock:
                 self._busy -= 1
@@ -320,6 +404,9 @@ class Worker:
         flush_spans()
         if debug_server is not None:
             debug_server.close()
+        with self._task_pool_lock:
+            if self._task_pool is not None:
+                self._task_pool.shutdown(wait=False)
         self._server.stop()
 
 

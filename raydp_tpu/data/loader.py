@@ -27,7 +27,7 @@ import os
 import queue
 import threading
 from collections import deque
-from typing import Dict, Iterator, List, Optional, Tuple
+from typing import Dict, Iterator, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
@@ -50,6 +50,28 @@ _TARGET_CHUNK_BYTES = int(
     __import__("os").environ.get("RAYDP_TRANSFER_CHUNK_MB", 128)
 ) * 1024 * 1024
 _MAX_COALESCE = 32
+
+
+class _PackedChunk(NamedTuple):
+    """Features + labels packed into ONE contiguous staging buffer.
+
+    A labeled chunk used to pay TWO device_put round trips (features,
+    then labels — on a ~100ms-RTT remote-TPU link that doubles the
+    per-chunk overhead the coalescing exists to amortize). Packing both
+    into a single uint8 buffer makes every chunk exactly one transfer;
+    the typed views are recovered on device with zero-cost bitcasts.
+    The packing memcpy happens producer-side (the staging generator /
+    prefetch thread), so it overlaps the in-flight transfer window.
+    """
+
+    buf: np.ndarray  # uint8, [x.nbytes + y.nbytes]
+    rows: int
+
+
+def _pack_chunk(x: np.ndarray, y: np.ndarray) -> _PackedChunk:
+    xb = np.ascontiguousarray(x).view(np.uint8).reshape(-1)
+    yb = np.ascontiguousarray(y).view(np.uint8).reshape(-1)
+    return _PackedChunk(np.concatenate([xb, yb]), x.shape[0])
 
 
 class JaxShardLoader:
@@ -165,15 +187,16 @@ class JaxShardLoader:
         return matrix, labels
 
     def _coalesce_batches(self) -> int:
-        """Batches per transfer chunk. Explicit setting wins; auto sizes
-        chunks toward ``_TARGET_CHUNK_BYTES`` capped at ``_MAX_COALESCE``
-        (host-path loaders — device None — stay at 1: there is no
-        transfer to amortize and per-batch granularity keeps prefetch
-        memory small)."""
-        if self.device is None:
-            return 1
+        """Batches per transfer chunk. Explicit setting ALWAYS wins —
+        including on the host path (device None), where a caller may want
+        bigger gather chunks for cache efficiency. Auto (None) sizes
+        chunks toward ``_TARGET_CHUNK_BYTES`` capped at ``_MAX_COALESCE``;
+        host-path auto stays at 1: there is no transfer to amortize and
+        per-batch granularity keeps prefetch memory small."""
         if self.transfer_coalesce is not None:
             return max(1, self.transfer_coalesce)
+        if self.device is None:
+            return 1
         row_bytes = (
             self.num_features * self.feature_dtype.itemsize
             + (self.label_dtype.itemsize if self.label_column else 0)
@@ -184,10 +207,16 @@ class JaxShardLoader:
         )
 
     def _staged_chunks(
-        self, epoch: int, rows_per_chunk: int
-    ) -> Iterator[Tuple[np.ndarray, Optional[np.ndarray]]]:
+        self, epoch: int, rows_per_chunk: int, pack: bool = False
+    ) -> Iterator:
         """Gather the epoch's rows in ``rows_per_chunk`` pieces (a chunk
-        is ``transfer_coalesce`` batches; 1 batch on the host path)."""
+        is ``transfer_coalesce`` batches; 1 batch on the host path).
+
+        ``pack=True`` (device path with labels): each chunk is emitted as
+        a :class:`_PackedChunk` — features and labels in one staging
+        buffer — so the consumer ships it with a single device_put. The
+        pack memcpy runs HERE, on the producer side, overlapping the
+        consumer's in-flight transfers."""
         matrix, labels = self._stage_matrix()
         n = matrix.shape[0]
         order = None
@@ -225,34 +254,67 @@ class JaxShardLoader:
                 bytes_meter.add(
                     x.nbytes + (y.nbytes if y is not None else 0)
                 )
+                chunk = (
+                    _pack_chunk(x, y) if pack and y is not None else (x, y)
+                )
             _flight.record("loader", "chunk", epoch=epoch, rank=self._rank,
                            rows=hi - lo)
-            yield x, y
+            yield chunk
+
+    def _unpack_device(self, buf, rows: int):
+        """On-device recovery of (features, labels) from one packed
+        buffer: slices + reshapes + bitcasts are async XLA ops on bytes
+        already resident — no further host↔device traffic."""
+        from jax import lax
+
+        nf = self.num_features
+        fsz = self.feature_dtype.itemsize
+        lsz = self.label_dtype.itemsize
+        nb_x = rows * nf * fsz
+        xb = buf[:nb_x].reshape((rows, nf, fsz) if fsz > 1 else (rows, nf))
+        x = lax.bitcast_convert_type(xb, self.feature_dtype)
+        yb = buf[nb_x:nb_x + rows * lsz]
+        if lsz > 1:
+            yb = yb.reshape((rows, lsz))
+        y = lax.bitcast_convert_type(yb, self.label_dtype)
+        return x, y
 
     def _epoch_iter(self, epoch: int):
         import jax
 
         bs = self.batch_size
         chunk_batches = self._coalesce_batches()
-        source = self._staged_chunks(epoch, chunk_batches * bs)
+        device = self.device
+        # Labeled device chunks are packed producer-side so each chunk is
+        # exactly ONE device_put (unlabeled chunks already are).
+        pack = device is not None and self.label_column is not None
+        source = self._staged_chunks(epoch, chunk_batches * bs, pack=pack)
         stop_event = None
         if self.prefetch > 0:
             # prefetch counts CHUNKS: with coalescing the host-side
             # staging holds at most prefetch × chunk bytes.
             source, stop_event = _background(source, self.prefetch)
 
-        device = self.device
         batch_counter = metrics.counter_add
 
         def put_chunk(chunk):
-            x, y = chunk
-            if device is not None:
+            if isinstance(chunk, _PackedChunk):
                 # Bracketed: a host→device transfer that never completes
                 # (remote-TPU link wedge) is a classic silent hang.
                 with _watchdog.inflight("ingest/device_put",
                                         rank=self._rank):
+                    buf = jax.device_put(chunk.buf, device)
+                batch_counter("ingest/device_puts")
+                return self._unpack_device(buf, chunk.rows)
+            x, y = chunk
+            if device is not None:
+                with _watchdog.inflight("ingest/device_put",
+                                        rank=self._rank):
                     x = jax.device_put(x, device)
                     y = jax.device_put(y, device) if y is not None else None
+                batch_counter(
+                    "ingest/device_puts", 1 if y is None else 2
+                )
             return x, y
 
         def batches_of(chunk):

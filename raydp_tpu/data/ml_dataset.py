@@ -271,8 +271,15 @@ class MLDataset:
         """Device-feeding batch iterator for this shard (the TPU-native
         counterpart of ``to_torch``, reference dataset.py:411-443).
 
-        ``transfer_coalesce`` batches ship per ``device_put`` (None =
-        auto-size to ~32MB chunks; 1 = per-batch transfers) and up to
+        ``transfer_coalesce`` batches ship per ``device_put``; features
+        and labels pack into ONE staged buffer per chunk, so a chunk is
+        exactly one transfer. ``None`` = auto-size: on the device path,
+        chunks grow toward ~128MB (``RAYDP_TRANSFER_CHUNK_MB``, capped at
+        32 batches); on the host path (``device=None``) auto stays at one
+        batch per chunk — there is no transfer to amortize and per-batch
+        granularity keeps prefetch memory small. An EXPLICIT value is
+        honored on both paths (host callers may want bigger gather chunks
+        for cache efficiency); ``1`` = per-batch transfers. Up to
         ``transfer_window`` chunk transfers stay in flight — see
         loader.py's module docstring for why this matters on
         high-latency device links."""

@@ -24,6 +24,7 @@ from typing import Any, Callable, List, Optional, Sequence
 
 import pyarrow as pa
 
+from raydp_tpu.cluster.cluster import TaskSpec
 from raydp_tpu.store.object_store import ObjectRef, ObjectStore
 from raydp_tpu.telemetry import span
 from raydp_tpu.utils.profiling import metrics
@@ -294,12 +295,13 @@ class ClusterExecutor(Executor):
             return ctx.put_table(fn(table), holder=True)
 
         with _stage_span("map_partitions", len(parts), "cluster"):
-            futures = [
-                self.cluster.submit_async(
-                    task, ref, worker_id=self._worker_for(i, ref)
-                )
+            # One RunTaskBatch envelope per worker (not per partition):
+            # per-call gRPC+pickle overhead amortizes over all of that
+            # worker's partitions, and fn serializes once per envelope.
+            futures = self.cluster.submit_batch([
+                TaskSpec(task, (ref,), worker_id=self._worker_for(i, ref))
                 for i, ref in enumerate(parts)
-            ]
+            ])
             return [f.result() for f in futures]
 
     def map_partitions_indexed(self, parts, fn):
@@ -308,11 +310,10 @@ class ClusterExecutor(Executor):
             return ctx.put_table(fn(table, index), holder=True)
 
         with _stage_span("map_partitions_indexed", len(parts), "cluster"):
-            futures = [
-                self.cluster.submit_async(task, ref, i,
-                                          worker_id=self._worker_for(i, ref))
+            futures = self.cluster.submit_batch([
+                TaskSpec(task, (ref, i), worker_id=self._worker_for(i, ref))
                 for i, ref in enumerate(parts)
-            ]
+            ])
             return [f.result() for f in futures]
 
     def part_nbytes(self, part):
@@ -367,12 +368,10 @@ class ClusterExecutor(Executor):
             return ctx.put_table(fn(ta, tb), holder=True)
 
         with _stage_span("map_pairs", len(parts_a), "cluster"):
-            futures = [
-                self.cluster.submit_async(
-                    task, ra, rb, worker_id=self._worker_for(i, ra)
-                )
+            futures = self.cluster.submit_batch([
+                TaskSpec(task, (ra, rb), worker_id=self._worker_for(i, ra))
                 for i, (ra, rb) in enumerate(zip(parts_a, parts_b))
-            ]
+            ])
             return [f.result() for f in futures]
 
     def exchange(self, parts, splitter, n_out, combine=None):
@@ -388,26 +387,38 @@ class ClusterExecutor(Executor):
             return ctx.put_table(merged, holder=True)
 
         with _stage_span("exchange", len(parts), "cluster"):
-            futures = [
-                self.cluster.submit_async(split_task, ref,
-                                          worker_id=self._worker_for(i, ref))
+            split_futures = self.cluster.submit_batch([
+                TaskSpec(split_task, (ref,),
+                         worker_id=self._worker_for(i, ref))
                 for i, ref in enumerate(parts)
-            ]
-            chunk_refs = [f.result() for f in futures]  # [n_in][n_out]
-            merge_futures = [
-                self.cluster.submit_async(
+            ])
+            chunk_refs = [f.result() for f in split_futures]  # [n_in][n_out]
+            merge_futures = self.cluster.submit_batch([
+                TaskSpec(
                     merge_task,
-                    [chunks[i] for chunks in chunk_refs],
+                    ([chunks[i] for chunks in chunk_refs],),
                     worker_id=self._worker_for(i),
                 )
                 for i in range(n_out)
-            ]
-            outs = [f.result() for f in merge_futures]
-            # Intermediate chunks are dead weight now.
-            for chunks in chunk_refs:
-                for ref in chunks:
-                    self.store.delete(ref)
-            return outs
+            ])
+            # Merge i consumes exactly chunk column i, so its inputs are
+            # dead the moment that merge lands — free them then, instead
+            # of holding the whole shuffle's intermediates until the full
+            # barrier (peak shm across a shuffle drops to the still-
+            # unmerged columns).
+            def _free(fut, refs):
+                for ref in refs:
+                    try:
+                        self.store.delete(ref)
+                    except Exception:
+                        pass
+
+            for i, f in enumerate(merge_futures):
+                column = [chunks[i] for chunks in chunk_refs]
+                f.add_done_callback(
+                    lambda fut, refs=column: _free(fut, refs)
+                )
+            return [f.result() for f in merge_futures]
 
     def materialize(self, part):
         return self.cluster.resolver.get_arrow_table(part)
@@ -426,7 +437,14 @@ class ClusterExecutor(Executor):
         blocks on executors, not the driver) — without this, every
         partition would start on the driver node and locality routing
         would keep all work there. Written holder-owned: base data must
-        survive pool shrinks (kill_worker contract)."""
+        survive pool shrinks (kill_worker contract).
+
+        The table itself travels the DATA plane (``data_args``): it is
+        written once into the driver's shm store and the RunTask envelope
+        carries only the ref — a co-located worker re-puts it from a
+        zero-copy mmap view, a remote one streams it from the driver
+        node's agent in bounded chunks. No table bytes ride the control
+        plane."""
         workers = self.cluster.alive_workers()
         if not workers:
             from concurrent.futures import Future
@@ -440,7 +458,9 @@ class ClusterExecutor(Executor):
         def ingest(ctx, t):
             return ctx.put_table(t, holder=True)
 
-        return self.cluster.submit_async(ingest, table, worker_id=target)
+        return self.cluster.submit_async(
+            ingest, worker_id=target, data_args=(table,)
+        )
 
     def num_rows(self, part):
         return part.num_rows if isinstance(part, ObjectRef) else -1
@@ -454,9 +474,8 @@ class ClusterExecutor(Executor):
         def task(ctx, ref):
             return _sample_table(ctx.get_table(ref), column, k)
 
-        futures = [
-            self.cluster.submit_async(task, ref,
-                                      worker_id=self._worker_for(i, ref))
+        futures = self.cluster.submit_batch([
+            TaskSpec(task, (ref,), worker_id=self._worker_for(i, ref))
             for i, ref in enumerate(parts)
-        ]
+        ])
         return [f.result() for f in futures]

@@ -136,7 +136,16 @@ class SPMDJob:
         timeout: float = 30.0,
         hosts: Optional[List[str]] = None,
         coordinator_port: Optional[int] = None,
+        register_hard_timeout: Optional[float] = None,
     ):
+        """``timeout`` is the registration barrier's SOFT window (resets
+        on progress); ``register_hard_timeout`` caps how long ranks that
+        are alive-but-slow are waited on past it. Default ``None`` keeps
+        the historical ``max(10 × soft, 300)`` — pass a small value so a
+        wedged rank fails a short-timeout job in seconds, not minutes.
+        The env vars (``RAYDP_SPMD_REGISTER_TIMEOUT`` /
+        ``RAYDP_SPMD_REGISTER_HARD_TIMEOUT``) still override both, same
+        precedence as the soft window's."""
         if world_size < 1:
             raise ValueError("world_size must be >= 1")
         self.job_name = job_name
@@ -145,6 +154,7 @@ class SPMDJob:
         self.script_prepare_fn = script_prepare_fn
         self.base_env = dict(env or {})
         self.timeout = timeout
+        self.register_hard_timeout = register_hard_timeout
         self.hosts = hosts or ["127.0.0.1"]
         self.coordinator_port = coordinator_port
         self._multihost = any(
@@ -293,17 +303,13 @@ class SPMDJob:
         cold JAX/grpc imports on a busy one-core host can take minutes),
         so: the soft window (``timeout``, env ``RAYDP_SPMD_REGISTER_
         TIMEOUT``) resets whenever a new rank registers, and workers that
-        are still *alive* are waited on past it up to the hard cap (env
-        ``RAYDP_SPMD_REGISTER_HARD_TIMEOUT``, default ``max(10×soft,
-        300)``s). Dead-without-registering ranks fail fast via the
-        process watcher. Failure messages carry each rank's log tail."""
-        soft = float(
-            os.environ.get(ENV_REGISTER_TIMEOUT) or self.timeout
-        )
-        hard = float(
-            os.environ.get(ENV_REGISTER_HARD_TIMEOUT)
-            or max(10.0 * soft, 300.0)
-        )
+        are still *alive* are waited on past it up to the hard cap
+        (constructor ``register_hard_timeout``, env
+        ``RAYDP_SPMD_REGISTER_HARD_TIMEOUT`` overriding, default
+        ``max(10×soft, 300)``s). Dead-without-registering ranks fail fast
+        via the process watcher. Failure messages carry each rank's log
+        tail."""
+        soft, hard = self._registration_timeouts()
         start_t = time.monotonic()
         deadline = start_t + soft
         seen = 0
@@ -327,6 +333,20 @@ class SPMDJob:
                 f"(soft={soft:.0f}s hard={hard:.0f}s, "
                 f"workers alive={alive})" + tails
             )
+
+    def _registration_timeouts(self) -> "tuple[float, float]":
+        """(soft, hard) windows for the registration barrier. Env vars
+        keep precedence over constructor values (same pattern as the
+        soft window: a deployed job can be retuned without code)."""
+        soft = float(os.environ.get(ENV_REGISTER_TIMEOUT) or self.timeout)
+        hard_env = os.environ.get(ENV_REGISTER_HARD_TIMEOUT)
+        if hard_env:
+            hard = float(hard_env)
+        elif self.register_hard_timeout is not None:
+            hard = float(self.register_hard_timeout)
+        else:
+            hard = max(10.0 * soft, 300.0)
+        return soft, hard
 
     def _log_tails(self, limit: int = 2000) -> str:
         """Last ``limit`` bytes of every rank's captured output, formatted

@@ -38,6 +38,24 @@ def agent_handlers(store: ObjectStore) -> Dict[str, Callable[[dict], dict]]:
         object_id = req["object_id"]
         return {"data": store.get_bytes(object_id)}
 
+    def fetch_chunk(req: dict) -> dict:
+        # Chunked-streaming fetch: resolvers pull big objects as a series
+        # of bounded slices instead of one monolithic reply (which rode
+        # the 512MB gRPC message cap and held one copy of the whole
+        # object in the reply pickle). The slice is cut zero-copy from
+        # the mmap'd segment; only the reply serialization copies it.
+        object_id = req["object_id"]
+        offset = int(req.get("offset", 0))
+        length = int(req.get("length", 0))
+        buf = store.get_buffer(object_id)
+        total = buf.size
+        if length <= 0 or offset + length > total:
+            length = max(0, total - offset)
+        return {
+            "data": buf.slice(offset, length).to_pybytes(),
+            "size": total,
+        }
+
     def unlink(req: dict) -> dict:
         return {"deleted": store.delete(req["object_id"])}
 
@@ -47,6 +65,7 @@ def agent_handlers(store: ObjectStore) -> Dict[str, Callable[[dict], dict]]:
 
     return {
         "FetchObject": fetch,
+        "FetchObjectChunk": fetch_chunk,
         "UnlinkObject": unlink,
         "DestroyStore": destroy,
     }

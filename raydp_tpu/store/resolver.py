@@ -9,15 +9,22 @@ that node's store agent over gRPC.
 """
 from __future__ import annotations
 
+import os
 import threading
 from typing import Callable, Dict, Optional, Tuple
 
 import pyarrow as pa
 
 from raydp_tpu.store.object_store import ObjectRef, ObjectStore
+from raydp_tpu.utils.profiling import metrics as _metrics
 
 # meta_fn(object_id) -> (ref, agent) where agent = {"address","service"}|None
 MetaFn = Callable[[str], Tuple[Optional[ObjectRef], Optional[dict]]]
+
+
+def _fetch_chunk_bytes() -> int:
+    """Slice size for remote fetches (bounded streaming, not one blob)."""
+    return int(os.environ.get("RAYDP_TPU_FETCH_CHUNK_MB", "32")) * 1024 * 1024
 
 
 class ObjectResolver:
@@ -86,9 +93,40 @@ class ObjectResolver:
                 f"{object_id[:8]}… is unreachable"
             )
         client = self._client(agent)
-        reply = client.call("FetchObject", {"object_id": object_id},
-                            timeout=120.0)
-        return reply["data"]
+        # Pull the object as a series of bounded slices. Replaces the
+        # monolithic FetchObject blob (whole object in one reply pickle,
+        # capped by the 512MB gRPC message limit): peak memory per RPC is
+        # one chunk, and objects larger than the message cap still move.
+        chunk = max(1024 * 1024, _fetch_chunk_bytes())
+        reply = client.call(
+            "FetchObjectChunk",
+            {"object_id": object_id, "offset": 0, "length": chunk},
+            timeout=120.0,
+        )
+        total = int(reply["size"])
+        first = reply["data"]
+        _metrics.counter_add("store/remote_fetch_bytes", total)
+        _metrics.counter_add("store/remote_fetches")
+        if len(first) >= total:
+            return first
+        out = bytearray(total)
+        out[: len(first)] = first
+        offset = len(first)
+        while offset < total:
+            reply = client.call(
+                "FetchObjectChunk",
+                {"object_id": object_id, "offset": offset, "length": chunk},
+                timeout=120.0,
+            )
+            data = reply["data"]
+            if not data:
+                raise RuntimeError(
+                    f"short read fetching {object_id[:8]}…: "
+                    f"{offset}/{total} bytes"
+                )
+            out[offset : offset + len(data)] = data
+            offset += len(data)
+        return bytes(out)
 
     def _client(self, agent: dict):
         from raydp_tpu.cluster.rpc import RpcClient

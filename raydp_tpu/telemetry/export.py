@@ -206,6 +206,12 @@ def render_prometheus(view: Dict[str, Any]) -> str:
         "Watchdog-detected stall episodes: a component's oldest "
         "in-flight op exceeded RAYDP_TPU_WATCHDOG_STALL_S.",
     )
+    rpc_payload = _Family(
+        "raydp_rpc_payload_bytes", "counter",
+        "Serialized request-envelope bytes this process sent over the "
+        "control plane. Tables move through the shm object store, so a "
+        "fat series here means some path is smuggling data through RPC.",
+    )
 
     sources: Dict[str, Dict[str, Any]] = dict(view.get("workers") or {})
     driver = view.get("driver")
@@ -237,6 +243,12 @@ def render_prometheus(view: Dict[str, Any]) -> str:
                         # alert expression.
                         stalls.add({"worker": worker_id}, section[name])
                         continue
+                    if name == "rpc/payload_bytes":
+                        # Control-plane hygiene signal (see family help);
+                        # dedicated so dashboards can plot it against
+                        # store/remote_fetch_bytes without label tricks.
+                        rpc_payload.add({"worker": worker_id}, section[name])
+                        continue
                     counters.add(
                         {"worker": worker_id, "name": name}, section[name]
                     )
@@ -256,7 +268,7 @@ def render_prometheus(view: Dict[str, Any]) -> str:
 
     lines: List[str] = []
     for family in (up, counters, meter_total, meter_rate, timers, dropped,
-                   stalls):
+                   stalls, rpc_payload):
         lines.extend(family.render())
     return "\n".join(lines) + ("\n" if lines else "")
 

@@ -201,3 +201,51 @@ def test_flash_backward_kernels_full_parity(causal):
             np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4,
             err_msg=f"d{name} mismatch",
         )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_bf16_forward(causal):
+    """MXU low-precision path: bf16 q/k/v through the pallas kernel vs
+    an fp32 reference over the SAME (bf16-quantized) inputs. The kernel
+    keeps its softmax/accumulation in fp32 (_masked_scores), so the
+    output should track the fp32 reference to bf16 resolution (~2^-8),
+    not drift with sequence length."""
+    # NOTE: _qkv's / np.sqrt(d) promotes bf16 back to fp32 (the fp32
+    # no-op-astype trap this test exists to close) — cast AFTER.
+    q, k, v = (t.astype(jnp.bfloat16)
+               for t in _qkv(b=2, s=128, h=2, d=32))
+    q32, k32, v32 = (t.astype(jnp.float32) for t in (q, k, v))
+    expected = reference_attention(q32, k32, v32, causal=causal)
+    got = flash_attention(q, k, v, causal=causal, block_q=32, block_kv=32,
+                          interpret=True)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(got, dtype=np.float32), np.asarray(expected),
+        rtol=2e-2, atol=2e-2,
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_bf16_backward(causal):
+    """bf16 gradients (dq, dk, dv) from the blockwise backward kernels
+    stay within low-precision tolerance of the fp32 reference grads."""
+    q, k, v = (t.astype(jnp.bfloat16)
+               for t in _qkv(b=1, s=64, h=2, d=16, seed=5))
+    q32, k32, v32 = (t.astype(jnp.float32) for t in (q, k, v))
+
+    def loss_flash(q, k, v):
+        out = flash_attention(q, k, v, causal=causal, block_q=32,
+                              block_kv=32, interpret=True)
+        return (out.astype(jnp.float32) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (reference_attention(q, k, v, causal=causal) ** 2).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q32, k32, v32)
+    for a, b, name in zip(gf, gr, "qkv"):
+        assert a.dtype == jnp.bfloat16, f"d{name} dtype {a.dtype}"
+        np.testing.assert_allclose(
+            np.asarray(a, dtype=np.float32), np.asarray(b),
+            rtol=6e-2, atol=6e-2, err_msg=f"d{name} mismatch",
+        )
