@@ -1366,6 +1366,154 @@ def bench_dataplane():
     return out
 
 
+def bench_etl_shuffle():
+    """Shuffle engine v2 evidence: (a) the one-pass argsort/take
+    partitioner vs the legacy one-filter-scan-per-bucket splitter on the
+    same table, (b) elided-vs-forced window→groupBy latency (the
+    co-partitioning planner's headline win), (c) groupBy/join/orderBy
+    rows/s through the locality-scheduled exchange with the
+    local-vs-total shuffle-byte split from the metrics registry."""
+    import pandas as pd
+    import pyarrow as pa
+
+    import raydp_tpu
+    import raydp_tpu.dataframe as rdf
+    from raydp_tpu.dataframe import dataframe as D
+    from raydp_tpu.dataframe import window as W
+    from raydp_tpu.dataframe.dataframe import _hash_bucket, _split_by_bucket
+    from raydp_tpu.utils.profiling import metrics
+
+    out = {}
+    # --- partitioner microbench (single table, no cluster) ------------
+    n_rows, n_buckets = 1_500_000, 16
+    rng = np.random.RandomState(17)
+    t = pa.table(
+        {
+            "k": rng.randint(0, 100_000, n_rows),
+            "v": rng.randn(n_rows),
+            "w": rng.randn(n_rows),
+        }
+    )
+    bucket = _hash_bucket(t, ["k"], n_buckets)
+
+    def legacy_split(table, b, n):
+        return [table.filter(pa.array(b == i)) for i in range(n)]
+
+    _split_by_bucket(t, bucket, n_buckets)  # warm
+    legacy_split(t, bucket, n_buckets)
+    one_pass = legacy = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        _split_by_bucket(t, bucket, n_buckets)
+        one_pass = min(one_pass, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        legacy_split(t, bucket, n_buckets)
+        legacy = min(legacy, time.perf_counter() - t0)
+    out["partitioner"] = {
+        "one_pass_rows_per_sec": round(n_rows / one_pass, 1),
+        "legacy_filter_rows_per_sec": round(n_rows / legacy, 1),
+        "speedup": round(legacy / one_pass, 2),
+        "buckets": n_buckets,
+    }
+
+    # --- cluster phase: elision + locality -----------------------------
+    pdf = pd.DataFrame(
+        {
+            "k": rng.randint(0, 10_000, n_rows),
+            "v": rng.randn(n_rows),
+        }
+    )
+    rdim = pd.DataFrame(
+        {"k": np.arange(10_000), "dim": rng.randn(10_000)}
+    )
+    saved = (
+        D._EXCHANGE_COALESCE_BYTES,
+        D._AGG_COALESCE_BYTES,
+        D._COMBINE_COALESCE_BYTES,
+    )
+    session = raydp_tpu.init(app_name="bench-shuffle", num_workers=4)
+    try:
+        # Defeat the adaptive coalescers so the timings measure real
+        # multi-partition exchanges, not a single-table collapse.
+        D._EXCHANGE_COALESCE_BYTES = 0
+        D._AGG_COALESCE_BYTES = 0
+        D._COMBINE_COALESCE_BYTES = 0
+
+        def counters():
+            c = metrics.snapshot().get("counters", {})
+            return (
+                c.get("shuffle/bytes", 0.0),
+                c.get("shuffle/local_bytes", 0.0),
+                c.get("shuffle/elided", 0.0),
+            )
+
+        b0, l0, e0 = counters()
+        df = rdf.from_pandas(pdf, num_partitions=8)
+        w = W.Window.partitionBy("k").orderBy("v")
+        win = df.withColumn("rn", W.row_number().over(w))._flush()
+        win.groupBy("k").agg(("v", "sum")).count()  # warm
+
+        def timed(frame):
+            dt = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                frame.groupBy("k").agg(("v", "sum"), ("v", "mean")).count()
+                dt = min(dt, time.perf_counter() - t0)
+            return dt
+
+        elided_s = timed(win)
+        # Same partitions, planner metadata stripped → full re-exchange.
+        forced_s = timed(D.DataFrame(win._parts, win._executor))
+        out["window_groupby"] = {
+            "elided_rows_per_sec": round(n_rows / elided_s, 1),
+            "forced_rows_per_sec": round(n_rows / forced_s, 1),
+            "elision_speedup": round(forced_s / elided_s, 2),
+        }
+
+        dt = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            df.groupBy("k").agg(("v", "sum"), ("v", "mean")).count()
+            dt = min(dt, time.perf_counter() - t0)
+        out["groupby_rows_per_sec"] = round(n_rows / dt, 1)
+
+        dim = rdf.from_pandas(rdim, num_partitions=4)
+        df.join(dim, on="k").count()  # warm
+        dt = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            df.join(dim, on="k").count()
+            dt = min(dt, time.perf_counter() - t0)
+        out["join_rows_per_sec"] = round(n_rows / dt, 1)
+
+        df.orderBy("k").count()  # warm
+        dt = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            df.orderBy("k").count()
+            dt = min(dt, time.perf_counter() - t0)
+        out["orderby_rows_per_sec"] = round(n_rows / dt, 1)
+
+        b1, l1, e1 = counters()
+        moved, local = b1 - b0, l1 - l0
+        out["shuffle_bytes_total"] = int(moved)
+        out["shuffle_local_bytes"] = int(local)
+        out["shuffle_locality_ratio"] = (
+            round(local / moved, 3) if moved else None
+        )
+        out["shuffles_elided"] = int(e1 - e0)
+    finally:
+        (
+            D._EXCHANGE_COALESCE_BYTES,
+            D._AGG_COALESCE_BYTES,
+            D._COMBINE_COALESCE_BYTES,
+        ) = saved
+        raydp_tpu.stop()
+    out["unit"] = "rows/s"
+    out["host_cpus"] = os.cpu_count()
+    return out
+
+
 # ----------------------------------------------------------- main
 
 # The CPU matrix runs in THIS process (pinned to the CPU platform —
@@ -1376,6 +1524,9 @@ CPU_MATRIX = [
     ("nyctaxi_mlp", bench_nyctaxi),
     ("etl_groupby_shuffle", bench_etl_groupby),
     ("etl_window", bench_etl_window),
+    # Host-side like the ETL configs above: partitioner + planner
+    # evidence for the shuffle engine, full size in every mode.
+    ("etl_shuffle", bench_etl_shuffle),
     # Host-side like the ETL configs: cluster + loader mechanics, no
     # device math — full size even in CPU-fallback mode.
     ("dataplane", bench_dataplane),

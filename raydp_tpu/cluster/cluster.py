@@ -57,6 +57,11 @@ class TaskSpec:
     kwargs: Dict[str, Any] = field(default_factory=dict)
     worker_id: Optional[str] = None  # locality preference, not a pin
     data_args: Tuple = ()
+    # Node-level placement hint: when the preferred worker is gone (or
+    # none was named), any alive worker on this node still gets the
+    # zero-copy shm reads the hint was chosen for (shuffle merge
+    # placement). Softer than worker_id, harder than round-robin.
+    node_id: Optional[str] = None
 
 
 class _WorkerGone(Exception):
@@ -774,13 +779,9 @@ class Cluster:
                 groups: Dict[str, List[int]] = {}
                 try:
                     for i in pending:
-                        pref = specs[i].worker_id if attempt == 0 else None
-                        try:
-                            target = self._pick_worker(pref)
-                        except ClusterError:
-                            if pref is None:
-                                raise
-                            target = self._pick_worker(None)
+                        target = self._resolve_batch_target(
+                            specs[i], attempt
+                        )
                         groups.setdefault(target, []).append(i)
                 except ClusterError as exc:
                     # No alive workers (elastic respawn may still be
@@ -835,6 +836,27 @@ class Cluster:
         finally:
             for refs in staged:
                 self._discard_staged(refs)
+
+    def _resolve_batch_target(self, spec: TaskSpec, attempt: int) -> str:
+        """Placement for one batched task: the preferred worker on the
+        first attempt, then any alive worker on the spec's hint node
+        (``node_id`` — keeps shuffle merges next to their bytes when the
+        chosen worker died), then plain round-robin. Raises ClusterError
+        when nothing is alive."""
+        if attempt == 0 and spec.worker_id is not None:
+            try:
+                return self._pick_worker(spec.worker_id)
+            except ClusterError:
+                pass  # preferred worker gone; fall through to the node
+        if spec.node_id is not None:
+            node_workers = sorted(
+                w.worker_id
+                for w in self.alive_workers()
+                if w.node_id == spec.node_id
+            )
+            if node_workers:
+                return node_workers[next(self._rr) % len(node_workers)]
+        return self._pick_worker(None)
 
     def _call_batch_into(
         self,

@@ -20,6 +20,7 @@ import pyarrow.compute as pc
 
 from raydp_tpu.dataframe import expr as E
 from raydp_tpu.dataframe.executor import Executor, LocalExecutor, _concat
+from raydp_tpu.utils.profiling import metrics
 
 ColumnLike = Union[str, E.Expr]
 
@@ -53,6 +54,9 @@ class DataFrame:
         # pipeline there — fusing the gather with the next stage instead
         # of paying an extra store round-trip for an eager concat.
         self._pending_gather = False
+        # Memoized schema probe; frames are immutable, so once probed it
+        # never changes. Derived frames start unset (None).
+        self._schema: Optional[pa.Schema] = None
 
     # -- plan helpers ---------------------------------------------------
     def _with(self, fn: Callable[[pa.Table], pa.Table]) -> "DataFrame":
@@ -88,6 +92,7 @@ class DataFrame:
             parts = self._executor.map_partitions(self._parts, run)
         out = DataFrame(parts, self._executor)
         out._exchange_keys = self._exchange_keys  # rows did not move
+        out._schema = self._schema  # pipeline already reflected in probe
         return out
 
     def mapPartitions(self, fn: Callable[[pa.Table], pa.Table]) -> "DataFrame":
@@ -110,7 +115,7 @@ class DataFrame:
 
         ``keeps_keys(keys)`` says whether the stage preserves the key
         columns (for exchange-elision on chained window ops)."""
-        from raydp_tpu.dataframe.window import find_window_exprs
+        from raydp_tpu.dataframe.window import find_window_exprs, keys_cover
 
         wins = [w for e in exprs for w in find_window_exprs(e)]
         keys: Optional[tuple] = None
@@ -118,13 +123,20 @@ class DataFrame:
         if wins:
             keys = tuple(wins[0].spec.partition_keys)
             for w in wins[1:]:
-                if tuple(w.spec.partition_keys) != keys:
+                if set(w.spec.partition_keys) != set(keys):
                     raise ValueError(
                         "all window functions in one projection must share "
                         f"partition keys; got {list(keys)} and "
                         f"{w.spec.partition_keys}"
                     )
-            if self._exchange_keys != keys:
+            if keys_cover(self._exchange_keys, keys):
+                # Already hash-partitioned on a subset of the window keys
+                # → every window partition is whole inside one physical
+                # partition; the window fn fuses into the pending
+                # pipeline with no shuffle.
+                if len(self._parts) > 1 and not self._pending_gather:
+                    metrics.counter_add("shuffle/elided")
+            else:
                 base = self._exchange_by_keys(list(keys))
 
         if any(E.find_nodes(e, E.MonotonicId) for e in exprs):
@@ -139,24 +151,19 @@ class DataFrame:
 
             parts = df._executor.map_partitions_indexed(df._parts, indexed)
             out = DataFrame(parts, df._executor)
-            out._exchange_keys = df._exchange_keys
         else:
             out = base._with(fn)
 
-        if keys is not None:
-            out._exchange_keys = (
-                keys if keeps_keys is None or keeps_keys(keys) else None
-            )
-        elif self._exchange_keys is not None and keeps_keys is not None:
-            # No window in this stage: existing co-location survives iff
-            # the stage preserves the key columns (row subsets, plain
-            # column adds) — lets window → narrow op → window chains
-            # still elide the second shuffle.
-            out._exchange_keys = (
-                self._exchange_keys
-                if keeps_keys(self._exchange_keys)
-                else None
-            )
+        # Propagate the ACTUAL partitioning of the evaluated base (which
+        # may be finer than the window keys when the exchange was elided):
+        # it survives iff the stage preserves those key columns.
+        actual = base._exchange_keys
+        out._exchange_keys = (
+            actual
+            if actual is not None
+            and (keeps_keys is None or keeps_keys(actual))
+            else None
+        )
         return out
 
     def select(self, *columns: ColumnLike) -> "DataFrame":
@@ -202,7 +209,18 @@ class DataFrame:
 
     def _exchange_by_keys(self, keys: List[str]) -> "DataFrame":
         """Hash-exchange so rows with equal key values land on the same
-        partition (the shuffle behind window functions and distinct)."""
+        partition (the shuffle behind window functions and distinct).
+
+        Elided entirely when the frame is already hash-partitioned on a
+        subset of ``keys`` (co-partitioning planner): equal key tuples
+        are then already co-located, so the flushed frame is returned
+        as-is — keeping its ORIGINAL (coarser ⇒ stronger) keys."""
+        from raydp_tpu.dataframe.window import keys_cover
+
+        if keys_cover(self._exchange_keys, keys):
+            if len(self._parts) > 1 and not self._pending_gather:
+                metrics.counter_add("shuffle/elided")
+            return self._flush()
         df = self._flush()
         n_out = max(1, len(df._parts))
         if n_out == 1:
@@ -268,7 +286,10 @@ class DataFrame:
                     pdf, preserve_index=False, schema=t.schema
                 )
 
-        return exchanged._with(dedupe)._flush()
+        out = exchanged._with(dedupe)._flush()
+        # Dedupe drops rows in place — the exchange's co-location holds.
+        out._exchange_keys = exchanged._exchange_keys
+        return out
 
     dropDuplicates = distinct
 
@@ -411,22 +432,58 @@ class DataFrame:
 
     def limit(self, n: int) -> "DataFrame":
         # Narrow approximation then global trim at collect time would be
-        # wrong for counts; do it eagerly.
-        df = self._flush()
+        # wrong for counts; do it eagerly — but only over the PREFIX of
+        # partitions actually consumed: the pending pipeline runs on
+        # exponentially widening partition batches (1, 2, 4, ...) and
+        # stops the moment ``remaining`` hits 0, instead of flushing the
+        # whole frame to take its first n rows.
+        if n <= 0:
+            return DataFrame([], self._executor)
+        df = self
+        if self._pending_gather and len(self._parts) > 1:
+            df = self._flush()  # coalesce collapses to one partition anyway
+        pipeline = list(df._pending)
+
+        def run(table: pa.Table) -> pa.Table:
+            for fn in pipeline:
+                table = fn(table)
+            return table
+
         out_parts: List[Any] = []
+        leftovers: List[Any] = []  # flushed past the cut; freed below
         remaining = n
-        for part in df._parts:
-            if remaining <= 0:
-                break
-            rows = df._executor.num_rows(part)
-            if 0 <= rows <= remaining:
-                out_parts.append(part)
-                remaining -= rows
-            else:
-                table = df._executor.materialize(part).slice(0, remaining)
-                out_parts.append(df._executor.put(table))
-                remaining = 0
-        return DataFrame(out_parts, df._executor)
+        i, batch = 0, 1
+        while i < len(df._parts) and remaining > 0:
+            raw = df._parts[i:i + batch]
+            i += batch
+            batch = min(batch * 2, 8)
+            chunk = (
+                df._executor.map_partitions(raw, run) if pipeline else raw
+            )
+            for part in chunk:
+                if remaining <= 0:
+                    if pipeline:
+                        leftovers.append(part)
+                    continue
+                rows = df._executor.num_rows(part)
+                if rows < 0:
+                    rows = df._executor.materialize(part).num_rows
+                if rows <= remaining:
+                    out_parts.append(part)
+                    remaining -= rows
+                else:
+                    trimmed = df._executor.map_partitions(
+                        [part], lambda t, r=remaining: t.slice(0, r)
+                    )
+                    out_parts.append(trimmed[0])
+                    if pipeline:
+                        leftovers.append(part)
+                    remaining = 0
+        if leftovers:
+            df._executor.discard(leftovers)
+        out = DataFrame(out_parts, df._executor)
+        out._exchange_keys = df._exchange_keys  # prefix of partitions
+        return out
 
     def union(self, other: "DataFrame") -> "DataFrame":
         a, b = self._flush(), other._flush()
@@ -487,6 +544,33 @@ class DataFrame:
 
         from raydp_tpu.dataframe.executor import ClusterExecutor
 
+        # Co-partitioned zip join: when BOTH sides are already
+        # hash-partitioned on exactly these keys with equal fanout and
+        # matching key dtypes (the bucket function is a pure function of
+        # key order, arrow types, and n_out), bucket i of the left can
+        # only match bucket i of the right — join partition pairs in
+        # place, no exchange and no broadcast. Valid for every join type
+        # including outer joins: unmatched rows of either side exist in
+        # exactly one bucket.
+        tkeys = tuple(keys)
+        if (
+            left._exchange_keys == tkeys
+            and right._exchange_keys == tkeys
+            and len(left._parts) == len(right._parts)
+            and len(left._parts) > 0
+            and _key_types_match(left, right, keys)
+        ):
+            if len(left._parts) > 1:
+                metrics.counter_add("shuffle/elided", 2)
+            parts = left._executor.map_pairs(
+                left._parts,
+                _coerce_parts(right, left._executor),
+                lambda lt, rt: _join_aligned(lt, rt, keys, join_type),
+            )
+            out = DataFrame(parts, left._executor)
+            out._exchange_keys = tkeys
+            return out
+
         # Right/full outer joins MUST shuffle: a per-partition broadcast
         # join emits each unmatched right row once per left partition
         # (every partition independently null-pads it) — wrong results,
@@ -523,7 +607,11 @@ class DataFrame:
             def fn(t: pa.Table) -> pa.Table:
                 return _join_aligned(t, right_table, keys, join_type)
 
-        return left._with(fn)
+        out = left._with(fn)
+        # Broadcast joins don't move left rows; left's partitioning (its
+        # key columns survive the join output) carries through.
+        out._exchange_keys = left._exchange_keys
+        return out
 
     def orderBy(
         self, *columns: str, ascending: Union[bool, List[bool]] = True
@@ -662,15 +750,19 @@ class DataFrame:
 
     @property
     def schema(self) -> pa.Schema:
-        head = self._peek()
-        return head.schema
+        # Frames are immutable, so one probe serves every access —
+        # repeated .schema/.columns reads must not re-fetch partitions.
+        if self._schema is None:
+            self._schema = self._peek().schema
+        return self._schema
 
     def _peek(self) -> pa.Table:
-        """First partition with pending ops applied (schema probe)."""
+        """First rows of the first partition with pending ops applied
+        (schema probe). Under the cluster executor the head rows are cut
+        worker-side — the driver never pulls the whole partition."""
         if not self._parts:
             return pa.table({})
-        table = self._executor.materialize(self._parts[0])
-        probe = table.slice(0, min(32, table.num_rows))
+        probe = self._executor.head(self._parts[0], 32)
         for fn in self._pending:
             probe = fn(probe)
         return probe
@@ -685,13 +777,51 @@ class DataFrame:
     cache = persist
 
     def write_parquet(self, path: str) -> None:
+        """Write one ``part-NNNNN.parquet`` file per partition, all
+        partitions concurrently: worker-side under the cluster executor
+        (partitions never transit the driver; workers share the
+        filesystem), a thread pool locally (parquet encoding releases
+        the GIL)."""
         import os
 
         import pyarrow.parquet as pq
 
-        os.makedirs(path, exist_ok=True)
-        for i, table in enumerate(self.collect_partitions()):
-            pq.write_table(table, f"{path}/part-{i:05d}.parquet")
+        df = self._flush()
+        # Workers run with their own cwd — anchor relative paths here.
+        target_dir = os.path.abspath(path)
+        os.makedirs(target_dir, exist_ok=True)
+        names = [
+            os.path.join(target_dir, f"part-{i:05d}.parquet")
+            for i in range(len(df._parts))
+        ]
+
+        from raydp_tpu.dataframe.executor import ClusterExecutor
+
+        if isinstance(df._executor, ClusterExecutor):
+            from raydp_tpu.cluster.cluster import TaskSpec
+
+            def write_one(ctx, ref, name):
+                table = ctx.get_table(ref)
+                os.makedirs(os.path.dirname(name), exist_ok=True)
+                pq.write_table(table, name)
+                return True
+
+            futures = df._executor.cluster.submit_batch([
+                TaskSpec(
+                    write_one, (ref, name),
+                    worker_id=df._executor._worker_for(i, ref),
+                )
+                for i, (ref, name) in enumerate(zip(df._parts, names))
+            ])
+            for f in futures:
+                f.result()
+            return
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(
+            max_workers=min(8, max(1, len(df._parts)))
+        ) as pool:
+            list(pool.map(pq.write_table, df._parts, names))
 
     # -- shard handoff (M5 consumes this) --------------------------------
     def to_object_refs(self, owner_transfer: bool = True) -> List[Any]:
@@ -814,30 +944,6 @@ class GroupedData:
         partial_specs = list(dict.fromkeys(partial_specs))
 
         df = self.df._flush()
-        # -- adaptive plan (Spark AQE-style, sized from partition stats) --
-        # Tier 1: small input + ops arrow can finalize in one pass → ONE
-        # task running arrow's hash aggregation (internally multithreaded).
-        # A process-level exchange on data this size would spend more on
-        # task orchestration + IPC than on aggregation.
-        total_bytes = sum(
-            df._executor.part_nbytes(p) for p in df._parts
-        )
-        if total_bytes <= _AGG_COALESCE_BYTES and _direct_agg_supported(specs):
-            keys_ = list(keys)
-            specs_ = list(specs)
-
-            def direct(table: pa.Table) -> pa.Table:
-                return _direct_agg(table, keys_, specs_)
-
-            part = df._executor.run_coalesced(
-                df._parts, direct, pre_concat=True
-            )
-            return DataFrame([part], df._executor)
-        # Fan-out scales with the cluster (the old hard cap of 8 was a
-        # scaling cliff — VERDICT r1 weak 6).
-        n_out = max(
-            1, min(len(df._parts), df._executor.default_fanout())
-        )
         # Bind plain locals for the shipped closures — referencing ``self``
         # would drag the executor (locks, sockets) into cloudpickle.
         mergeable = dict(self._MERGEABLE)
@@ -845,11 +951,10 @@ class GroupedData:
         def partial_fn(t: pa.Table) -> pa.Table:
             return _local_agg(t, keys, partial_specs)
 
-        splitter = _bucket_splitter(list(keys), n_out)
-
         def combine(t: pa.Table) -> pa.Table:
-            if t.num_rows == 0:
-                return t
+            # No empty early-return: an empty bucket must still finalize
+            # to the FINAL output schema (partial-schema empties would
+            # leak into schema probes and per-partition elided aggs).
             merge_specs = []
             rename = {}
             list_partials = []  # (partial_name, final_arrow_op)
@@ -937,6 +1042,60 @@ class GroupedData:
                 )
             return _finalize_agg(merged, keys, specs)
 
+        # -- adaptive plan (Spark AQE-style, sized from partition stats) --
+        # Tier 0 (co-partitioning planner): the frame is already
+        # hash-partitioned on a subset of the groupBy keys, so every
+        # group lives whole inside one partition — aggregate each
+        # partition independently, NO shuffle at all. Output partitions
+        # keep the input's (coarser ⇒ stronger) co-location keys.
+        from raydp_tpu.dataframe.window import keys_cover
+
+        if keys_cover(df._exchange_keys, keys) and not df._pending_gather:
+            if len(df._parts) > 1:
+                metrics.counter_add("shuffle/elided")
+            if _direct_agg_supported(specs):
+                keys_ = list(keys)
+                specs_ = list(specs)
+
+                def elided(table: pa.Table) -> pa.Table:
+                    return _direct_agg(table, keys_, specs_)
+
+            else:
+
+                def elided(table: pa.Table) -> pa.Table:
+                    return combine(_local_agg(table, keys, partial_specs))
+
+            parts = df._executor.map_partitions(df._parts, elided)
+            out = DataFrame(parts, df._executor)
+            out._exchange_keys = df._exchange_keys
+            return out
+        # Tier 1: small input + ops arrow can finalize in one pass → ONE
+        # task running arrow's hash aggregation (internally multithreaded).
+        # A process-level exchange on data this size would spend more on
+        # task orchestration + IPC than on aggregation.
+        total_bytes = sum(
+            df._executor.part_nbytes(p) for p in df._parts
+        )
+        if total_bytes <= _AGG_COALESCE_BYTES and _direct_agg_supported(specs):
+            keys_ = list(keys)
+            specs_ = list(specs)
+
+            def direct(table: pa.Table) -> pa.Table:
+                return _direct_agg(table, keys_, specs_)
+
+            part = df._executor.run_coalesced(
+                df._parts, direct, pre_concat=True
+            )
+            out = DataFrame([part], df._executor)
+            out._exchange_keys = tuple(keys)  # single partition
+            return out
+        # Fan-out scales with the cluster (the old hard cap of 8 was a
+        # scaling cliff — VERDICT r1 weak 6).
+        n_out = max(
+            1, min(len(df._parts), df._executor.default_fanout())
+        )
+        splitter = _bucket_splitter(list(keys), n_out)
+
         # Tier 2/3: map-side partial aggregation first (shrinks the data
         # to ~groups × partitions rows), THEN size the shuffle from the
         # measured partial sizes: small partials merge in one task; big
@@ -957,10 +1116,17 @@ class GroupedData:
 
             part = df._executor.run_coalesced(partials, merge_all)
             df._executor.discard(partials)
-            return DataFrame([part], df._executor)
+            out = DataFrame([part], df._executor)
+            out._exchange_keys = tuple(keys)  # single partition
+            return out
         parts = df._executor.exchange(partials, splitter, n_out, combine)
         df._executor.discard(partials)
-        return DataFrame(parts, df._executor)
+        out = DataFrame(parts, df._executor)
+        # The exchange bucketed the partials by the groupBy keys; each
+        # output row stays in its bucket, so the result is hash-
+        # partitioned on them — downstream wide ops on these keys elide.
+        out._exchange_keys = tuple(keys)
+        return out
 
 
 # -- helpers ---------------------------------------------------------------
@@ -977,6 +1143,18 @@ def _join_aligned(
                 rt.column_names.index(k), k, pc.cast(rt.column(k), lt_type)
             )
     return t.join(rt, keys=keys, join_type=join_type)
+
+
+def _key_types_match(a: "DataFrame", b: "DataFrame", keys: List[str]) -> bool:
+    """Whether both frames carry the join keys with IDENTICAL arrow
+    types. The hash-bucket function picks its algorithm from the key
+    schema and hashes raw values, so co-partitioning of two frames is
+    only comparable when the key dtypes match exactly."""
+    try:
+        sa, sb = a.schema, b.schema
+        return all(sa.field(k).type == sb.field(k).type for k in keys)
+    except KeyError:
+        return False
 
 
 def _as_expr(c: ColumnLike) -> E.Expr:
@@ -1080,6 +1258,13 @@ def _hash_bucket(t: pa.Table, keys: List[str], n: int) -> np.ndarray:
 def _split_by_bucket(t: pa.Table, bucket: np.ndarray, n: int) -> List[pa.Table]:
     """One stable sort + take, then zero-copy slices per bucket — replaces
     n full filter scans in the exchange splitters."""
+    # Narrow the sort key first: numpy's stable argsort radix-sorts
+    # uint8/uint16 in O(n) single-digit passes, ~16x the int64
+    # comparison sort at 1.5M rows — and fan-outs never exceed 2^16.
+    if n <= np.iinfo(np.uint8).max:
+        bucket = bucket.astype(np.uint8)
+    elif n <= np.iinfo(np.uint16).max:
+        bucket = bucket.astype(np.uint16)
     order = np.argsort(bucket, kind="stable")
     taken = t.take(pa.array(order))
     counts = np.bincount(bucket, minlength=n)
@@ -1169,32 +1354,77 @@ def _shuffle_join(
     """Shuffle hash join: both sides exchange on the join keys with the
     SAME bucketing, then bucket i joins bucket i (Spark's
     SortMergeJoin/ShuffledHashJoin role for large×large joins; the
-    broadcast join handles the dimension-table case)."""
-    n_out = max(
-        1,
-        min(
-            max(len(left._parts), len(right._parts)),
-            left._executor.default_fanout(),
-        ),
-    )
-    sch = left.schema  # one _peek: schema access materializes a probe
-    left_schema = {k: sch.field(k).type for k in keys}
-    lparts = left._executor.exchange(
-        left._parts, _bucket_splitter(keys, n_out), n_out
-    )
-    rparts = left._executor.exchange(
-        _coerce_parts(right, left._executor),
-        _bucket_splitter(keys, n_out, cast_to=left_schema),
-        n_out,
-    )
+    broadcast join handles the dimension-table case).
+
+    One-sided elision: when ONE side is already hash-partitioned on
+    exactly these keys, only the other side exchanges — into the
+    partitioned side's fanout, with its key dtypes (the bucket function
+    must be identical on both sides)."""
+    tkeys = tuple(keys)
+    lparts: List[Any] = []
+    rparts: List[Any] = []
+    l_tmp = r_tmp = True  # whether the part lists are exchange temps
+    if left._exchange_keys == tkeys and left._parts and _key_types_match(
+        left, right, keys
+    ):
+        # Left already bucketed → re-bucket only the right, to left's
+        # fanout/dtypes. Left's parts are the frame's LIVE partitions —
+        # never discarded here.
+        n_out = len(left._parts)
+        if n_out > 1:
+            metrics.counter_add("shuffle/elided")
+        lparts, l_tmp = list(left._parts), False
+        sch = left.schema
+        left_schema = {k: sch.field(k).type for k in keys}
+        rparts = left._executor.exchange(
+            _coerce_parts(right, left._executor),
+            _bucket_splitter(keys, n_out, cast_to=left_schema),
+            n_out,
+        )
+    elif right._exchange_keys == tkeys and right._parts and _key_types_match(
+        left, right, keys
+    ):
+        n_out = len(right._parts)
+        if n_out > 1:
+            metrics.counter_add("shuffle/elided")
+        rparts, r_tmp = _coerce_parts(right, left._executor), False
+        sch = right.schema
+        right_schema = {k: sch.field(k).type for k in keys}
+        lparts = left._executor.exchange(
+            left._parts,
+            _bucket_splitter(keys, n_out, cast_to=right_schema),
+            n_out,
+        )
+    else:
+        n_out = max(
+            1,
+            min(
+                max(len(left._parts), len(right._parts)),
+                left._executor.default_fanout(),
+            ),
+        )
+        sch = left.schema  # one _peek: schema access materializes a probe
+        left_schema = {k: sch.field(k).type for k in keys}
+        lparts = left._executor.exchange(
+            left._parts, _bucket_splitter(keys, n_out), n_out
+        )
+        rparts = left._executor.exchange(
+            _coerce_parts(right, left._executor),
+            _bucket_splitter(keys, n_out, cast_to=left_schema),
+            n_out,
+        )
 
     def join_pair(lt: pa.Table, rt: pa.Table) -> pa.Table:
         return _join_aligned(lt, rt, keys, join_type)
 
     parts = left._executor.map_pairs(lparts, rparts, join_pair)
-    left._executor.discard(lparts)
-    left._executor.discard(rparts)
-    return DataFrame(parts, left._executor)
+    if l_tmp:
+        left._executor.discard(lparts)
+    if r_tmp:
+        left._executor.discard(rparts)
+    out = DataFrame(parts, left._executor)
+    out._exchange_keys = tkeys
+    return out
 
 
 def _direct_agg_supported(specs: List[Tuple[str, str]]) -> bool:

@@ -164,6 +164,12 @@ class Executor:
     def materialize(self, part: Any) -> pa.Table:
         raise NotImplementedError
 
+    def head(self, part: Any, k: int) -> pa.Table:
+        """First ``k`` rows of one partition (schema/peek probes).
+        Backends cut the head where the partition lives — the driver
+        never pulls the whole table for a 32-row probe."""
+        raise NotImplementedError
+
     def put(self, table: pa.Table) -> Any:
         raise NotImplementedError
 
@@ -219,7 +225,14 @@ class LocalExecutor(Executor):
 
     def exchange(self, parts, splitter, n_out, combine=None):
         with _stage_span("exchange", len(parts), "local"):
+            metrics.counter_add("shuffle/exchanges")
             chunked = list(self._pool.map(splitter, parts))
+            moved = sum(
+                c.nbytes for chunks in chunked for c in chunks
+            )
+            metrics.counter_add("shuffle/bytes", moved)
+            # Single host: every chunk is already local to its merge.
+            metrics.counter_add("shuffle/local_bytes", moved)
             outs = []
             for i in range(n_out):
                 merged = _concat([chunks[i] for chunks in chunked])
@@ -239,6 +252,9 @@ class LocalExecutor(Executor):
 
     def materialize(self, part):
         return part
+
+    def head(self, part, k):
+        return part.slice(0, min(k, part.num_rows))
 
     def put(self, table):
         return table
@@ -374,6 +390,40 @@ class ClusterExecutor(Executor):
             ])
             return [f.result() for f in futures]
 
+    def _free_refs(self, refs) -> None:
+        for ref in refs:
+            if isinstance(ref, ObjectRef):
+                try:
+                    self.store.delete(ref)
+                except Exception:
+                    pass
+
+    def _merge_worker(self, index: int, refs):
+        """Locality-scheduled merge placement: (worker_id, node_id) of
+        the node already holding the most input bytes for this bucket —
+        those chunks are zero-copy shm reads there, only the minority
+        streams over. Workers on the winning node are spread by bucket
+        index; round-robin fallback when nothing is resident."""
+        by_node: dict = {}
+        for r in refs:
+            if isinstance(r, ObjectRef):
+                # max(size, 1): empty chunks still vote for their node.
+                by_node[r.node_id] = by_node.get(r.node_id, 0) + max(r.size, 1)
+        workers = self.cluster.alive_workers()
+        if by_node and workers:
+            # Sorted iteration breaks byte ties deterministically.
+            best = max(sorted(by_node), key=lambda n: by_node[n])
+            local = sorted(
+                w.worker_id for w in workers if w.node_id == best
+            )
+            if local:
+                return local[index % len(local)], best
+        wid = self._worker_for(index)
+        node = next(
+            (w.node_id for w in workers if w.worker_id == wid), None
+        )
+        return wid, node
+
     def exchange(self, parts, splitter, n_out, combine=None):
         def split_task(ctx, ref):
             table = ctx.get_table(ref)
@@ -386,42 +436,124 @@ class ClusterExecutor(Executor):
                 merged = combine(merged)
             return ctx.put_table(merged, holder=True)
 
+        def preconcat_task(ctx, refs):
+            # Eager pre-merge: concat only — ``combine`` runs exactly
+            # once per bucket, in the final merge.
+            return ctx.put_table(
+                _concat([ctx.get_table(r) for r in refs]), holder=True
+            )
+
+        # Eager pre-merge threshold: with >= N chunks of a bucket ready
+        # while splits are still running, concat them now so the final
+        # merge starts from partially-reduced inputs. Off by default —
+        # it trades intra-bucket row order (arrival order, not input
+        # order) for overlap, so it is an explicit opt-in.
+        try:
+            eager_min = int(
+                os.environ.get("RAYDP_TPU_EXCHANGE_EAGER_MERGE", "0") or 0
+            )
+        except ValueError:
+            eager_min = 0
+
         with _stage_span("exchange", len(parts), "cluster"):
+            metrics.counter_add("shuffle/exchanges")
             split_futures = self.cluster.submit_batch([
                 TaskSpec(split_task, (ref,),
                          worker_id=self._worker_for(i, ref))
                 for i, ref in enumerate(parts)
             ])
-            chunk_refs = [f.result() for f in split_futures]  # [n_in][n_out]
-            merge_futures = self.cluster.submit_batch([
-                TaskSpec(
-                    merge_task,
-                    ([chunks[i] for chunks in chunk_refs],),
-                    worker_id=self._worker_for(i),
-                )
-                for i in range(n_out)
-            ])
-            # Merge i consumes exactly chunk column i, so its inputs are
-            # dead the moment that merge lands — free them then, instead
-            # of holding the whole shuffle's intermediates until the full
-            # barrier (peak shm across a shuffle drops to the still-
-            # unmerged columns).
-            def _free(fut, refs):
-                for ref in refs:
-                    try:
-                        self.store.delete(ref)
-                    except Exception:
-                        pass
+            # Stream split completions (one envelope per worker resolves
+            # independently) instead of gathering in submission order:
+            # merge planning starts the moment the last chunk EXISTS,
+            # and the eager path can pre-concat hot buckets while slow
+            # splits are still running.
+            from concurrent.futures import FIRST_COMPLETED, wait as _wait
 
-            for i, f in enumerate(merge_futures):
-                column = [chunks[i] for chunks in chunk_refs]
+            idx_of = {f: i for i, f in enumerate(split_futures)}
+            chunks_by_part: List[Optional[list]] = [None] * len(parts)
+            avail: List[list] = [[] for _ in range(n_out)]
+            early: List[list] = [[] for _ in range(n_out)]
+            pending = set(split_futures)
+            while pending:
+                done, pending = _wait(pending, return_when=FIRST_COMPLETED)
+                for f in done:
+                    row = f.result()  # raises like the old ordered gather
+                    chunks_by_part[idx_of[f]] = row
+                    if eager_min > 0:
+                        for i, ref in enumerate(row):
+                            avail[i].append(ref)
+                if eager_min > 0 and pending:
+                    for i in range(n_out):
+                        if len(avail[i]) >= eager_min:
+                            batch, avail[i] = avail[i], []
+                            wid, _node = self._merge_worker(i, batch)
+                            fut = self.cluster.submit_async(
+                                preconcat_task, batch, worker_id=wid
+                            )
+                            fut.add_done_callback(
+                                lambda _f, refs=batch: self._free_refs(refs)
+                            )
+                            early[i].append(fut)
+
+            if eager_min > 0:
+                # Arrival order within a bucket (pre-merged blocks first).
+                inputs = [
+                    [f.result() for f in early[i]] + avail[i]
+                    for i in range(n_out)
+                ]
+            else:
+                # Deterministic: chunk i of every input, in input order.
+                inputs = [
+                    [chunks[i] for chunks in chunks_by_part]
+                    for i in range(n_out)
+                ]
+
+            specs, merge_inputs = [], []
+            total_b = local_b = 0
+            for i, refs in enumerate(inputs):
+                wid, node = self._merge_worker(i, refs)
+                for r in refs:
+                    if isinstance(r, ObjectRef):
+                        total_b += r.size
+                        if node is not None and r.node_id == node:
+                            local_b += r.size
+                specs.append(
+                    TaskSpec(merge_task, (refs,), worker_id=wid,
+                             node_id=node)
+                )
+                merge_inputs.append(refs)
+            metrics.counter_add("shuffle/bytes", total_b)
+            metrics.counter_add("shuffle/local_bytes", local_b)
+            merge_futures = self.cluster.submit_batch(specs)
+            # Merge i consumes exactly its input refs, so they are dead
+            # the moment that merge lands — free them then, instead of
+            # holding the whole shuffle's intermediates until the full
+            # barrier (peak shm across a shuffle drops to the still-
+            # unmerged buckets).
+            for f, refs in zip(merge_futures, merge_inputs):
                 f.add_done_callback(
-                    lambda fut, refs=column: _free(fut, refs)
+                    lambda fut, rr=refs: self._free_refs(rr)
                 )
             return [f.result() for f in merge_futures]
 
     def materialize(self, part):
         return self.cluster.resolver.get_arrow_table(part)
+
+    def head(self, part, k):
+        if not isinstance(part, ObjectRef):
+            return part.slice(0, min(k, part.num_rows))
+
+        def probe(ctx, ref, n):
+            table = ctx.get_table(ref)
+            n = min(n, table.num_rows)
+            # take(), not slice(): a slice pickles its PARENT buffers
+            # (the whole partition would ride the reply); take copies
+            # just the probe rows.
+            return table.take(pa.array(range(n), type=pa.int64()))
+
+        return self.cluster.submit_async(
+            probe, part, k, worker_id=self._worker_for(0, part)
+        ).result()
 
     def put(self, table):
         return self._put_async(table).result()

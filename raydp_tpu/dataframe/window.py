@@ -85,6 +85,21 @@ class Window:
     partition_by = partitionBy
 
 
+def keys_cover(existing, needed) -> bool:
+    """Whether a frame hash-partitioned on ``existing`` is already
+    co-located for a grouping op on ``needed`` (window partitions,
+    groupBy keys, distinct subset).
+
+    A hash exchange on K puts every row with equal K-values in one
+    partition, so any grouping whose key set is a SUPERSET of K is
+    automatically co-located too (each finer group lies wholly inside
+    one coarse group). The co-partitioning planner uses this to elide
+    shuffles; zipped joins do NOT go through here — they additionally
+    need identical bucket functions (exact key order, dtypes, fanout)
+    on both sides."""
+    return bool(existing) and set(existing) <= set(needed)
+
+
 class WindowFunction:
     """A window function awaiting ``.over(window_spec)``."""
 
@@ -269,30 +284,34 @@ class _WindowFrame:
             self.order_np = idx_np
             self._peer_change = pchange  # free by-product of the fused key
         else:
-            sort_keys = [(k, "ascending", "at_start") for k in keys]
+            sort_keys = [(k, "ascending") for k in keys]
             tmp = table
             for j, sk in enumerate(order):
                 direction = "ascending" if sk.ascending else "descending"
                 if tmp.column(sk.column).null_count == 0:
                     # Null-free key: plain sort, no indicator column.
-                    sort_keys.append((sk.column, direction, "at_start"))
+                    sort_keys.append((sk.column, direction))
                     continue
                 # Spark null ordering: nulls FIRST on ascending keys,
-                # LAST on descending — per key. Encode as an is-null
-                # indicator column sorted ahead of the key (1 first when
-                # nulls lead).
+                # LAST on descending — PER KEY, which arrow's single
+                # global null_placement can't express (sort_keys entries
+                # are strictly (name, order) pairs). Encode as a
+                # null-free is-null indicator column sorted ahead of the
+                # key (1 first when nulls lead); the key's own nulls are
+                # then already segregated by the indicator, so the
+                # global placement below never reorders visible rows.
                 nullcol = f"__raydp_w_null_{j}"
                 tmp = tmp.append_column(
                     nullcol,
                     pc.cast(pc.is_null(tmp.column(sk.column)), pa.int8()),
                 )
                 sort_keys.append(
-                    (nullcol,
-                     "descending" if sk.ascending else "ascending",
-                     "at_start")
+                    (nullcol, "descending" if sk.ascending else "ascending")
                 )
-                sort_keys.append((sk.column, direction, "at_start"))
-            idx = pc.sort_indices(tmp, sort_keys=sort_keys)
+                sort_keys.append((sk.column, direction))
+            idx = pc.sort_indices(
+                tmp, sort_keys=sort_keys, null_placement="at_start"
+            )
             self._idx = idx
             self.order_np = idx.to_numpy()
             # Group boundaries on the sorted order.

@@ -212,6 +212,22 @@ def render_prometheus(view: Dict[str, Any]) -> str:
         "control plane. Tables move through the shm object store, so a "
         "fat series here means some path is smuggling data through RPC.",
     )
+    shuffle_bytes = _Family(
+        "raydp_shuffle_bytes_total", "counter",
+        "Bytes entering exchange merge tasks (split-chunk sizes summed "
+        "at merge dispatch).",
+    )
+    shuffle_local = _Family(
+        "raydp_shuffle_local_bytes_total", "counter",
+        "Subset of raydp_shuffle_bytes_total already resident on the "
+        "merge worker's node — zero-copy shm reads. The ratio to the "
+        "total is the exchange locality hit-rate.",
+    )
+    shuffles_elided = _Family(
+        "raydp_shuffles_elided_total", "counter",
+        "Exchanges skipped by the co-partitioning planner because the "
+        "frame's existing hash partitioning already co-located the keys.",
+    )
 
     sources: Dict[str, Dict[str, Any]] = dict(view.get("workers") or {})
     driver = view.get("driver")
@@ -249,6 +265,20 @@ def render_prometheus(view: Dict[str, Any]) -> str:
                         # store/remote_fetch_bytes without label tricks.
                         rpc_payload.add({"worker": worker_id}, section[name])
                         continue
+                    if name == "shuffle/bytes":
+                        shuffle_bytes.add({"worker": worker_id}, section[name])
+                        continue
+                    if name == "shuffle/local_bytes":
+                        shuffle_local.add({"worker": worker_id}, section[name])
+                        continue
+                    if name == "shuffle/elided":
+                        # Dedicated families so the dashboard's locality
+                        # hit-rate and elision panels are one expression
+                        # each (local/total ratio, elided rate).
+                        shuffles_elided.add(
+                            {"worker": worker_id}, section[name]
+                        )
+                        continue
                     counters.add(
                         {"worker": worker_id, "name": name}, section[name]
                     )
@@ -268,7 +298,8 @@ def render_prometheus(view: Dict[str, Any]) -> str:
 
     lines: List[str] = []
     for family in (up, counters, meter_total, meter_rate, timers, dropped,
-                   stalls, rpc_payload):
+                   stalls, rpc_payload, shuffle_bytes, shuffle_local,
+                   shuffles_elided):
         lines.extend(family.render())
     return "\n".join(lines) + ("\n" if lines else "")
 

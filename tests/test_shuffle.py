@@ -1,0 +1,478 @@
+"""Shuffle engine v2: one-pass partitioner parity, co-partitioning
+planner elision, locality-scheduled exchange metrics, and the
+prefix-limit / schema-cache / concurrent-parquet satellites."""
+import glob
+import os
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import raydp_tpu
+import raydp_tpu.dataframe as rdf
+from raydp_tpu.dataframe import col, dataframe as D
+from raydp_tpu.dataframe.dataframe import (
+    _bucket_splitter,
+    _hash_bucket,
+    _split_by_bucket,
+)
+from raydp_tpu.dataframe.executor import ClusterExecutor, LocalExecutor
+from raydp_tpu.dataframe.window import Window, keys_cover
+from raydp_tpu.utils.profiling import metrics
+
+
+def _counter(name: str) -> float:
+    return metrics.snapshot().get("counters", {}).get(name, 0)
+
+
+@pytest.fixture()
+def forced_exchanges(monkeypatch):
+    """Defeat every adaptive-coalesce threshold so wide ops run REAL
+    exchanges (the thresholds are module globals read at plan time)."""
+    monkeypatch.setattr(D, "_EXCHANGE_COALESCE_BYTES", 0)
+    monkeypatch.setattr(D, "_AGG_COALESCE_BYTES", 0)
+    monkeypatch.setattr(D, "_COMBINE_COALESCE_BYTES", 0)
+
+
+def _kv(n=2000, n_keys=37, seed=0) -> pd.DataFrame:
+    rng = np.random.RandomState(seed)
+    return pd.DataFrame(
+        {"k": rng.randint(0, n_keys, n), "v": rng.randn(n)}
+    )
+
+
+# -- one-pass partitioner parity -----------------------------------------
+def _legacy_filter_split(t: pa.Table, bucket: np.ndarray, n: int):
+    """The pre-v2 splitter: one full filter scan per output bucket."""
+    return [t.filter(pa.array(bucket == i)) for i in range(n)]
+
+
+def test_split_by_bucket_matches_filter_splitter():
+    rng = np.random.RandomState(7)
+    t = pa.table({
+        "k": rng.randint(0, 1000, 5000),
+        "s": pa.array(
+            [None if i % 17 == 0 else f"row{i}" for i in range(5000)]
+        ),
+        "v": rng.randn(5000),
+    })
+    bucket = _hash_bucket(t, ["k"], 16)
+    fast = _split_by_bucket(t, bucket, 16)
+    legacy = _legacy_filter_split(t, bucket, 16)
+    assert len(fast) == len(legacy) == 16
+    for f, l in zip(fast, legacy):
+        # Both preserve within-bucket input order → row-for-row equal.
+        assert f.num_rows == l.num_rows
+        assert f.equals(l)
+    assert sum(p.num_rows for p in fast) == 5000
+
+
+def test_bucket_splitter_null_keys_consistent():
+    # Null keys must land in ONE bucket, consistently across partitions
+    # with different null layouts (validity-mask hashing).
+    a = pa.table({"k": pa.array([1, None, 2, None, 3], type=pa.int64())})
+    b = pa.table({"k": pa.array([None, 1, 3], type=pa.int64())})
+    split = _bucket_splitter(["k"], 4)
+    buckets_a = [
+        i for i, chunk in enumerate(split(a))
+        for v in chunk.column("k").to_pylist() if v is None
+    ]
+    buckets_b = [
+        i for i, chunk in enumerate(split(b))
+        for v in chunk.column("k").to_pylist() if v is None
+    ]
+    assert len(set(buckets_a + buckets_b)) == 1
+
+
+def test_bucket_splitter_empty_partition():
+    empty = pa.table({"k": pa.array([], type=pa.int64())})
+    chunks = _bucket_splitter(["k"], 4)(empty)
+    assert len(chunks) == 4
+    assert all(c.num_rows == 0 for c in chunks)
+    assert all(c.schema == empty.schema for c in chunks)
+
+
+def test_bucket_splitter_single_row_all_buckets_total():
+    one = pa.table({"k": pa.array([42], type=pa.int64()), "v": [1.5]})
+    chunks = _bucket_splitter(["k"], 8)(one)
+    assert sum(c.num_rows for c in chunks) == 1
+
+
+# -- co-partitioning planner ---------------------------------------------
+def test_keys_cover_rule():
+    assert keys_cover(("k",), ("k",))
+    assert keys_cover(("k",), ("k", "j"))  # subset ⇒ finer groups whole
+    assert not keys_cover(("k", "j"), ("k",))
+    assert not keys_cover(None, ("k",))
+    assert not keys_cover((), ("k",))
+
+
+def test_window_then_groupby_shuffles_once(forced_exchanges):
+    pdf = _kv()
+    df = rdf.from_pandas(pdf, num_partitions=4)
+    x0, e0 = _counter("shuffle/exchanges"), _counter("shuffle/elided")
+    win = df.withColumn(
+        "rn", rdf.row_number().over(Window.partitionBy("k").orderBy("v"))
+    )
+    out = win.groupBy("k").agg(("v", "sum"), ("v", "mean")).to_pandas()
+    assert _counter("shuffle/exchanges") - x0 == 1  # exactly one exchange
+    assert _counter("shuffle/elided") - e0 >= 1
+    exp = pdf.groupby("k")["v"].agg(["sum", "mean"]).reset_index()
+    got = (
+        out.rename(columns={"sum(v)": "sum", "mean(v)": "mean"})
+        .sort_values("k").reset_index(drop=True)
+    )
+    pd.testing.assert_frame_equal(
+        got[["k", "sum", "mean"]],
+        exp.sort_values("k").reset_index(drop=True),
+        check_dtype=False,
+    )
+
+
+def test_elided_agg_matches_forced(forced_exchanges):
+    pdf = _kv(seed=3)
+    df = rdf.from_pandas(pdf, num_partitions=4)
+    partitioned = df._exchange_by_keys(["k"])._flush()
+    assert partitioned._exchange_keys == ("k",)
+    elided = (
+        partitioned.groupBy("k")
+        .agg(("v", "sum"), ("v", "count"), ("v", "stddev"))
+        .to_pandas()
+    )
+    # Same frame with the planner metadata cleared → full exchange path.
+    stripped = D.DataFrame(partitioned._parts, partitioned._executor)
+    forced = (
+        stripped.groupBy("k")
+        .agg(("v", "sum"), ("v", "count"), ("v", "stddev"))
+        .to_pandas()
+    )
+    a = elided.sort_values("k").reset_index(drop=True)
+    b = forced.sort_values("k").reset_index(drop=True)
+    pd.testing.assert_frame_equal(a, b, check_dtype=False)
+
+
+def test_elided_agg_collect_list(forced_exchanges):
+    # collect_* can't use arrow's one-pass agg — the elided plan must
+    # route through the partial+combine pipeline per partition.
+    pdf = _kv(n=500, n_keys=11, seed=5)
+    df = rdf.from_pandas(pdf, num_partitions=3)
+    partitioned = df._exchange_by_keys(["k"])._flush()
+    out = partitioned.groupBy("k").agg(("v", "collect_list")).to_pandas()
+    sizes = {
+        row["k"]: len(row["collect_list(v)"]) for _, row in out.iterrows()
+    }
+    assert sizes == pdf.groupby("k")["v"].count().to_dict()
+
+
+def test_groupby_supserset_keys_elides(forced_exchanges):
+    # Partitioned on k ⇒ grouping on (k, j) is already co-located.
+    pdf = _kv(seed=9).assign(j=lambda d: d["k"] % 3)
+    df = rdf.from_pandas(pdf, num_partitions=4)
+    partitioned = df._exchange_by_keys(["k"])._flush()
+    e0, x0 = _counter("shuffle/elided"), _counter("shuffle/exchanges")
+    out = partitioned.groupBy("k", "j").agg(("v", "sum")).to_pandas()
+    assert _counter("shuffle/elided") - e0 >= 1
+    assert _counter("shuffle/exchanges") - x0 == 0
+    exp = pdf.groupby(["k", "j"])["v"].sum().reset_index()
+    got = out.sort_values(["k", "j"]).reset_index(drop=True)
+    pd.testing.assert_frame_equal(
+        got.rename(columns={"sum(v)": "v"}),
+        exp.sort_values(["k", "j"]).reset_index(drop=True),
+        check_dtype=False,
+    )
+
+
+def test_agg_output_carries_exchange_keys(forced_exchanges):
+    df = rdf.from_pandas(_kv(), num_partitions=4)
+    agged = df.groupBy("k").agg(("v", "sum"))
+    assert agged._exchange_keys == ("k",)
+    # ...and distinct on those keys reuses the layout.
+    e0 = _counter("shuffle/elided")
+    agged.distinct(["k"]).to_pandas()
+    assert _counter("shuffle/elided") - e0 >= 1
+
+
+def test_distinct_propagates_exchange_keys(forced_exchanges):
+    df = rdf.from_pandas(_kv(), num_partitions=4)
+    out = df.distinct(["k"])
+    assert out._exchange_keys == ("k",)
+
+
+def test_copartitioned_join_zip_matches_broadcast(forced_exchanges):
+    left_src = _kv(seed=11)
+    right_src = pd.DataFrame({
+        "k": np.arange(37), "w": np.arange(37) * 0.5
+    })
+    a = rdf.from_pandas(left_src, num_partitions=4).groupBy("k").agg(
+        ("v", "sum")
+    )
+    b = rdf.from_pandas(
+        pd.concat([right_src] * 3, ignore_index=True), num_partitions=4
+    ).groupBy("k").agg(("w", "max"))
+    assert a._exchange_keys == b._exchange_keys == ("k",)
+    assert a.num_partitions == b.num_partitions
+    e0, x0 = _counter("shuffle/elided"), _counter("shuffle/exchanges")
+    zipped = a.join(b, on="k").to_pandas()
+    assert _counter("shuffle/exchanges") - x0 == 0  # pure zip, no shuffle
+    assert _counter("shuffle/elided") - e0 >= 2
+    # Row-for-row against the broadcast join of the SAME inputs (fresh
+    # frames without planner metadata → broadcast path).
+    a2 = rdf.from_pandas(a.to_pandas())
+    b2 = rdf.from_pandas(b.to_pandas())
+    broadcast = a2.join(b2, on="k").to_pandas()
+    za = zipped.sort_values("k").reset_index(drop=True)
+    zb = broadcast.sort_values("k").reset_index(drop=True)
+    pd.testing.assert_frame_equal(
+        za[sorted(za.columns)], zb[sorted(zb.columns)], check_dtype=False
+    )
+
+
+def test_copartitioned_outer_join_zip(forced_exchanges):
+    # Outer joins are zip-safe too: unmatched keys live in exactly one
+    # bucket on each side.
+    a_src = pd.DataFrame({"k": np.arange(0, 30), "v": np.arange(30) * 1.0})
+    b_src = pd.DataFrame({"k": np.arange(15, 45), "w": np.arange(30) * 2.0})
+    a = rdf.from_pandas(a_src, num_partitions=3).groupBy("k").agg(("v", "sum"))
+    b = rdf.from_pandas(b_src, num_partitions=3).groupBy("k").agg(("w", "sum"))
+    x0 = _counter("shuffle/exchanges")
+    out = a.join(b, on="k", how="outer").to_pandas()
+    assert _counter("shuffle/exchanges") - x0 == 0
+    exp = pd.merge(
+        a_src.rename(columns={"v": "sum(v)"}),
+        b_src.rename(columns={"w": "sum(w)"}),
+        on="k", how="outer",
+    )
+    assert len(out) == len(exp) == 45
+    assert sorted(out["k"]) == sorted(exp["k"])
+
+
+def test_mismatched_fanout_does_not_zip(forced_exchanges):
+    # Equal keys but different partition counts → bucket functions
+    # differ → must NOT zip.
+    a = rdf.from_pandas(_kv(seed=2), num_partitions=4).groupBy("k").agg(
+        ("v", "sum")
+    )
+    b_frame = rdf.from_pandas(_kv(seed=4), num_partitions=2)
+    b = b_frame.groupBy("k").agg(("v", "count"))
+    if a.num_partitions == b.num_partitions:
+        pytest.skip("fanouts coincide on this host")
+    out = a.join(b, on="k").to_pandas()
+    exp = pd.merge(a.to_pandas(), b.to_pandas(), on="k")
+    assert len(out) == len(exp)
+
+
+def test_narrow_ops_preserve_keys_for_elision(forced_exchanges):
+    pdf = _kv(seed=21)
+    df = rdf.from_pandas(pdf, num_partitions=4)
+    agged = df.groupBy("k").agg(("v", "sum"))
+    kept = agged.filter(col("sum(v)") > -1e9).withColumn(
+        "double", col("sum(v)") * 2
+    )
+    assert kept._exchange_keys == ("k",)
+    # Overwriting a key column must DROP the metadata.
+    clobbered = agged.withColumn("k", col("sum(v)"))
+    assert clobbered._exchange_keys is None
+    # Projecting the key away must drop it too.
+    projected = agged.select(col("sum(v)").alias("s"))
+    assert projected._exchange_keys is None
+
+
+def test_elided_counter_in_prometheus(forced_exchanges):
+    from raydp_tpu.telemetry.export import render_prometheus
+
+    df = rdf.from_pandas(_kv(), num_partitions=4)
+    win = df.withColumn(
+        "rn", rdf.row_number().over(Window.partitionBy("k").orderBy("v"))
+    )
+    win.groupBy("k").agg(("v", "sum")).to_pandas()
+    text = render_prometheus({"driver": metrics.snapshot()})
+    assert "raydp_shuffles_elided_total" in text
+    assert "raydp_shuffle_bytes_total" in text
+    assert "raydp_shuffle_local_bytes_total" in text
+    line = next(
+        ln for ln in text.splitlines()
+        if ln.startswith("raydp_shuffles_elided_total{")
+    )
+    assert float(line.rsplit(" ", 1)[1]) >= 1
+
+
+# -- satellites ----------------------------------------------------------
+def test_limit_runs_pipeline_on_prefix_only():
+    calls = []
+
+    def spy(t: pa.Table) -> pa.Table:
+        calls.append(t.num_rows)
+        return t
+
+    df = rdf.from_pandas(_kv(n=400), num_partitions=4)
+    out = df.mapPartitions(spy).limit(5).to_pandas()
+    assert len(out) == 5
+    assert len(calls) == 1  # first partition (100 rows) already covers 5
+
+
+def test_limit_widening_batches_and_exact_rows():
+    df = rdf.from_pandas(_kv(n=400), num_partitions=8)
+    assert len(df.limit(170).to_pandas()) == 170
+    assert len(df.limit(400).to_pandas()) == 400
+    assert len(df.limit(4000).to_pandas()) == 400
+    assert df.limit(0).to_pandas().empty
+
+
+def test_limit_equals_head_of_flush():
+    pdf = _kv(n=300, seed=13)
+    df = rdf.from_pandas(pdf, num_partitions=4)
+    staged = df.withColumn("z", col("v") * 3).filter(col("v") > 0)
+    expected = staged.to_pandas().head(20).reset_index(drop=True)
+    got = staged.limit(20).to_pandas().reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, expected, check_dtype=False)
+
+
+def test_schema_probe_runs_once():
+    probes = []
+
+    class CountingExecutor(LocalExecutor):
+        def head(self, part, k):
+            probes.append(k)
+            return super().head(part, k)
+
+    df = D.DataFrame(
+        [pa.table({"a": [1, 2], "b": ["x", "y"]})], CountingExecutor()
+    )
+    assert df.columns == ["a", "b"]
+    assert df.schema.names == ["a", "b"]
+    _ = df.schema
+    assert len(probes) == 1
+
+
+def test_flush_carries_schema_cache():
+    df = rdf.from_pandas(_kv(n=50), num_partitions=2)
+    _ = df.schema
+    flushed = df._flush()
+    assert flushed._schema is not None
+
+
+def test_write_parquet_concurrent_local(tmp_path):
+    pdf = _kv(n=250, seed=8)
+    df = rdf.from_pandas(pdf, num_partitions=3)
+    out_dir = str(tmp_path / "out")
+    df.write_parquet(out_dir)
+    files = sorted(os.listdir(out_dir))
+    assert files == [f"part-{i:05d}.parquet" for i in range(3)]
+    back = pa.concat_tables(
+        [pq.read_table(f) for f in sorted(glob.glob(out_dir + "/*.parquet"))]
+    ).to_pandas()
+    pd.testing.assert_frame_equal(
+        back.sort_values(["k", "v"]).reset_index(drop=True),
+        pdf.sort_values(["k", "v"]).reset_index(drop=True),
+        check_dtype=False,
+    )
+
+
+# -- cluster backend -----------------------------------------------------
+@pytest.fixture(scope="module")
+def session():
+    s = raydp_tpu.init(app_name="shuffletest", num_workers=2,
+                       memory_per_worker="256MB")
+    yield s
+    raydp_tpu.stop()
+
+
+def test_cluster_exchange_reports_locality_bytes(session, forced_exchanges):
+    df = rdf.from_pandas(_kv(n=4000, seed=17), num_partitions=4)
+    assert isinstance(df._executor, ClusterExecutor)
+    b0, l0, x0 = (
+        _counter("shuffle/bytes"),
+        _counter("shuffle/local_bytes"),
+        _counter("shuffle/exchanges"),
+    )
+    out = df.groupBy("k").agg(("v", "sum")).to_pandas()
+    assert len(out) == 37
+    assert _counter("shuffle/exchanges") - x0 == 1
+    moved = _counter("shuffle/bytes") - b0
+    local = _counter("shuffle/local_bytes") - l0
+    assert moved > 0
+    assert 0 <= local <= moved
+
+
+def test_cluster_window_groupby_single_exchange(session, forced_exchanges):
+    pdf = _kv(n=3000, seed=23)
+    df = rdf.from_pandas(pdf, num_partitions=4)
+    x0, e0 = _counter("shuffle/exchanges"), _counter("shuffle/elided")
+    win = df.withColumn(
+        "rn", rdf.row_number().over(Window.partitionBy("k").orderBy("v"))
+    )
+    out = win.groupBy("k").agg(("v", "sum")).to_pandas()
+    assert _counter("shuffle/exchanges") - x0 == 1
+    assert _counter("shuffle/elided") - e0 >= 1
+    exp = pdf.groupby("k")["v"].sum().reset_index()
+    got = out.rename(columns={"sum(v)": "v"}).sort_values("k")
+    pd.testing.assert_frame_equal(
+        got.reset_index(drop=True),
+        exp.sort_values("k").reset_index(drop=True),
+        check_dtype=False,
+    )
+
+
+def test_cluster_eager_premerge_exchange(session, forced_exchanges,
+                                         monkeypatch):
+    monkeypatch.setenv("RAYDP_TPU_EXCHANGE_EAGER_MERGE", "2")
+    pdf = _kv(n=4000, seed=29)
+    df = rdf.from_pandas(pdf, num_partitions=6)
+    out = df.groupBy("k").agg(("v", "sum"), ("v", "count")).to_pandas()
+    exp = pdf.groupby("k")["v"].agg(["sum", "count"]).reset_index()
+    got = (
+        out.rename(columns={"sum(v)": "sum", "count(v)": "count"})
+        .sort_values("k").reset_index(drop=True)
+    )
+    pd.testing.assert_frame_equal(
+        got[["k", "sum", "count"]],
+        exp.sort_values("k").reset_index(drop=True),
+        check_dtype=False,
+    )
+
+
+def test_cluster_schema_probe_is_partial(session):
+    # head() must not ship the whole partition back for a schema probe.
+    pdf = _kv(n=5000, seed=31)
+    df = rdf.from_pandas(pdf, num_partitions=2)
+    probe = df._executor.head(df._parts[0], 32)
+    assert probe.num_rows <= 32
+    assert probe.schema.names == ["k", "v"]
+    assert df.columns == ["k", "v"]
+
+
+def test_cluster_write_parquet_worker_side(session, tmp_path):
+    pdf = _kv(n=600, seed=37)
+    df = rdf.from_pandas(pdf, num_partitions=3)
+    out_dir = str(tmp_path / "wp")
+    df.write_parquet(out_dir)
+    files = sorted(os.listdir(out_dir))
+    assert files == [f"part-{i:05d}.parquet" for i in range(3)]
+    back = pa.concat_tables(
+        [pq.read_table(f) for f in sorted(glob.glob(out_dir + "/*.parquet"))]
+    )
+    assert back.num_rows == 600
+
+
+def test_cluster_one_sided_shuffle_join_elision(session, forced_exchanges,
+                                                monkeypatch):
+    monkeypatch.setattr(D, "_BROADCAST_JOIN_BYTES", 0)  # force shuffle join
+    left = rdf.from_pandas(_kv(n=2000, seed=41), num_partitions=4)
+    a = left.groupBy("k").agg(("v", "sum"))
+    assert a._exchange_keys == ("k",)
+    right = rdf.from_pandas(_kv(n=1500, seed=43), num_partitions=4)
+    x0, e0 = _counter("shuffle/exchanges"), _counter("shuffle/elided")
+    joined = a.join(right, on="k").to_pandas()
+    # Only the RIGHT side exchanged; the agg output's layout was reused.
+    assert _counter("shuffle/exchanges") - x0 == 1
+    assert _counter("shuffle/elided") - e0 >= 1
+    exp = pd.merge(a.to_pandas(), right.to_pandas(), on="k")
+    assert len(joined) == len(exp)
+    assert joined["sum(v)"].sum() == pytest.approx(exp["sum(v)"].sum())
+
+
+# The bench-scale shuffle parity test lives in test_shuffle_scale.py
+# (tier-1 marker hygiene: this file imports raydp_tpu.telemetry, so it
+# must stay free of slow markers — see test_telemetry.py).

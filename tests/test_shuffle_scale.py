@@ -1,0 +1,45 @@
+"""Bench-scale shuffle parity (slow tier). Lives apart from
+test_shuffle.py so that file stays slow-marker-free — it imports the
+telemetry package, and tier-1 marker hygiene (test_telemetry.py)
+requires telemetry-touching test files to run entirely under the gate."""
+import numpy as np
+import pandas as pd
+import pytest
+
+import raydp_tpu
+import raydp_tpu.dataframe as rdf
+from raydp_tpu.dataframe import dataframe as D
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def session():
+    s = raydp_tpu.init(app_name="shufflescale", num_workers=2,
+                       memory_per_worker="256MB")
+    yield s
+    raydp_tpu.stop()
+
+
+def test_cluster_shuffle_scale_parity(session, monkeypatch):
+    monkeypatch.setattr(D, "_EXCHANGE_COALESCE_BYTES", 0)
+    monkeypatch.setattr(D, "_AGG_COALESCE_BYTES", 0)
+    monkeypatch.setattr(D, "_COMBINE_COALESCE_BYTES", 0)
+    # Bench-scale shuffle: enough rows that every partition really
+    # splits into every bucket, exercising the streaming merge path.
+    rng = np.random.RandomState(47)
+    pdf = pd.DataFrame(
+        {"k": rng.randint(0, 512, 200_000), "v": rng.randn(200_000)}
+    )
+    df = rdf.from_pandas(pdf, num_partitions=8)
+    out = df.groupBy("k").agg(("v", "sum"), ("v", "count")).to_pandas()
+    exp = pdf.groupby("k")["v"].agg(["sum", "count"]).reset_index()
+    got = (
+        out.rename(columns={"sum(v)": "sum", "count(v)": "count"})
+        .sort_values("k").reset_index(drop=True)
+    )
+    pd.testing.assert_frame_equal(
+        got[["k", "sum", "count"]],
+        exp.sort_values("k").reset_index(drop=True),
+        check_dtype=False,
+    )
