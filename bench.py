@@ -1502,6 +1502,73 @@ def bench_etl_shuffle():
             round(local / moved, 3) if moved else None
         )
         out["shuffles_elided"] = int(e1 - e0)
+
+        # --- zipfian skewed keys: partition-skew evidence --------------
+        # A zipf(1.3) key column concentrates a large fraction of rows
+        # in a handful of hash buckets; the stage-stats store reports
+        # the resulting max/mean partition-skew ratio the (future) AQE
+        # would re-plan on.
+        from raydp_tpu.telemetry.progress import stage_store
+
+        zkeys = np.minimum(rng.zipf(1.3, n_rows), 10_000) - 1
+        zdf = rdf.from_pandas(
+            pd.DataFrame({"k": zkeys, "v": rng.randn(n_rows)}),
+            num_partitions=8,
+        )
+        zdf.groupBy("k").agg(("v", "sum")).count()  # warm
+        dt = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            zdf.groupBy("k").agg(("v", "sum"), ("v", "mean")).count()
+            dt = min(dt, time.perf_counter() - t0)
+        # Raw-row exchange (window forces one): the head key's mass
+        # lands in one bucket, and the stage stats report the resulting
+        # partition-skew ratio the (future) AQE would re-plan on. The
+        # tiered groupBy above exchanges per-key PARTIALS, which is
+        # exactly why its latency stays flat under key skew.
+        last0 = stage_store.last_id()
+        zw = W.Window.partitionBy("k").orderBy("v")
+        zdf.withColumn("rn", W.row_number().over(zw))._flush()
+        zstats = [
+            s for s in stage_store.recent(64) if s.stage_id > last0
+        ]
+        out["skewed_groupby"] = {
+            "zipf_a": 1.3,
+            "rows_per_sec": round(n_rows / dt, 1),
+            "max_partition_skew": round(
+                max((s.skew for s in zstats), default=1.0), 3
+            ),
+            "stages": len(zstats),
+        }
+
+        # --- stage-stats overhead: the <5% guarantee -------------------
+        # Interleaved runs + medians: a single best-of-N on a ~50ms op
+        # turns scheduler noise into a fake overhead number.
+        def one_groupby():
+            t0 = time.perf_counter()
+            df.groupBy("k").agg(("v", "sum"), ("v", "mean")).count()
+            return time.perf_counter() - t0
+
+        ons, offs = [], []
+        try:
+            for i in range(10):
+                if i % 2:
+                    ons.append(one_groupby())
+                else:
+                    os.environ["RAYDP_TPU_STAGE_STATS"] = "0"
+                    offs.append(one_groupby())
+                    os.environ.pop("RAYDP_TPU_STAGE_STATS", None)
+        finally:
+            os.environ.pop("RAYDP_TPU_STAGE_STATS", None)
+        ons.sort(), offs.sort()
+        stats_on, stats_off = ons[len(ons) // 2], offs[len(offs) // 2]
+        out["stage_stats_overhead"] = {
+            "enabled_s": round(stats_on, 4),
+            "disabled_s": round(stats_off, 4),
+            "overhead_frac": round(
+                (stats_on - stats_off) / stats_off if stats_off else 0.0, 4
+            ),
+        }
     finally:
         (
             D._EXCHANGE_COALESCE_BYTES,
@@ -1658,15 +1725,22 @@ def _run_and_stamp(fn) -> dict:
     and the process metrics registry (reset per config) is attached —
     the ingest meters / step-timer percentiles behind each number ride
     along in the emitted JSON."""
+    from raydp_tpu.utils.memory import host_rss_bytes, reset_peak_rss
     from raydp_tpu.utils.profiling import metrics
 
     metrics.reset()  # per-config telemetry, not cumulative across configs
+    # Fresh peak-RSS window per section; where clear_refs is unsupported
+    # the peak is the process lifetime high-water mark instead.
+    peak_windowed = reset_peak_rss()
     t0 = time.perf_counter()
     try:
         res = fn()
     except Exception as exc:  # record, keep benching
         res = {"error": f"{type(exc).__name__}: {exc}"}
     res["seconds"] = round(time.perf_counter() - t0, 1)
+    peak = host_rss_bytes()[1]
+    res["peak_rss_bytes"] = peak
+    res["peak_rss_windowed"] = peak_windowed
     snap = metrics.snapshot()
     if snap.get("counters") or len(snap) > 1:
         res["telemetry"] = snap

@@ -131,6 +131,9 @@ class _RemoteMaster:
     def health_report(self) -> dict:
         return self._client.call("HealthReport", {})["report"]
 
+    def progress_report(self) -> dict:
+        return self._client.call("ProgressReport", {})["report"]
+
     def mark_worker_dead(self, worker_id: str, reason: str = "") -> None:
         # Best-effort: the real master's own monitors are authoritative;
         # a client merely stops routing to the worker.
@@ -214,6 +217,20 @@ class RemoteCluster:
         cluster-owning process, not this client)."""
         return self.master.health_report()
 
+    def progress_report(self) -> dict:
+        """Stage progress as seen from THIS client (DataFrame stages
+        run on the submitting driver), with the cluster-owning
+        process's report attached under ``"cluster"``."""
+        from raydp_tpu.telemetry.progress import progress, stage_store
+
+        report = progress.report()
+        report["stage_totals"] = stage_store.snapshot()["totals"]
+        try:
+            report["cluster"] = self.master.progress_report()
+        except Exception:
+            pass  # older master without the ProgressReport handler
+        return report
+
     # -- task submission ------------------------------------------------
     def submit(self, fn, *args, worker_id=None, timeout=300.0, **kwargs):
         return self.submit_async(
@@ -228,6 +245,7 @@ class RemoteCluster:
         timeout: float = 300.0,
         retries: int = 2,
         data_args=(),
+        meta_sink: Optional[Callable] = None,
         **kwargs,
     ) -> Future:
         """Like ``Cluster.submit_async``; ``data_args`` tables are staged
@@ -271,6 +289,14 @@ class RemoteCluster:
                 client = self._worker_client(target)
                 try:
                     reply = client.call("RunTask", payload, timeout=timeout)
+                    if meta_sink is not None:
+                        try:
+                            meta_sink(
+                                0, target.worker_id,
+                                reply.get("exec_s", 0.0),
+                            )
+                        except Exception:
+                            pass
                     return reply["result"]
                 except grpc.RpcError as exc:
                     code = exc.code()
@@ -300,9 +326,12 @@ class RemoteCluster:
 
     # -- batched submission (one envelope per worker) --------------------
     def submit_batch(self, specs, timeout: float = 300.0,
-                     retries: int = 2) -> List[Future]:
+                     retries: int = 2,
+                     meta_sink: Optional[Callable] = None) -> List[Future]:
         """Client-mode twin of ``Cluster.submit_batch``: one RunTaskBatch
-        envelope per worker, one Future per spec (in order)."""
+        envelope per worker, one Future per spec (in order).
+        ``meta_sink(spec_index, worker_id, exec_s)`` fires before the
+        matching future resolves, mirroring the in-process Cluster."""
         futures: List[Future] = [Future() for _ in specs]
         if not specs:
             return futures
@@ -313,7 +342,9 @@ class RemoteCluster:
         def orchestrate():
             with _prop.propagated(trace_ctx):
                 try:
-                    self._run_batch(list(specs), futures, timeout, retries)
+                    self._run_batch(
+                        list(specs), futures, timeout, retries, meta_sink
+                    )
                 except BaseException as exc:  # noqa: BLE001
                     for f in futures:
                         if not f.done():
@@ -322,7 +353,7 @@ class RemoteCluster:
         self._pool.submit(orchestrate)
         return futures
 
-    def _run_batch(self, specs, futures, timeout, retries):
+    def _run_batch(self, specs, futures, timeout, retries, meta_sink=None):
         import grpc
 
         staged = [self._stage_data_args(s.data_args) for s in specs]
@@ -406,6 +437,13 @@ class RemoteCluster:
                         continue
                     for i, res in zip(idxs, outcome):
                         if res.get("ok"):
+                            if meta_sink is not None:
+                                try:
+                                    meta_sink(
+                                        i, wid, res.get("exec_s", 0.0)
+                                    )
+                                except Exception:
+                                    pass
                             futures[i].set_result(res.get("value"))
                         else:
                             futures[i].set_exception(RpcError(

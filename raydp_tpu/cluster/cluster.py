@@ -173,7 +173,8 @@ class Cluster:
             return
         try:
             self._metrics_server = serve_prometheus(
-                self.prometheus_metrics, int(port)
+                self.prometheus_metrics, int(port),
+                progress=self.progress_report,
             )
             logger.info(
                 "prometheus scrape endpoint on :%d/metrics",
@@ -587,6 +588,18 @@ class Cluster:
             return None
         return self.master.health_report()
 
+    def progress_report(self) -> dict:
+        """Live stage progress — in-flight stages with done/total task
+        counts, recently completed stages, and stage-store totals. Also
+        served on ``/debug/progress`` of the driver's metrics endpoint."""
+        if self.master is not None:
+            return self.master.progress_report()
+        from raydp_tpu.telemetry.progress import progress, stage_store
+
+        report = progress.report()
+        report["stage_totals"] = stage_store.snapshot()["totals"]
+        return report
+
     # -- task submission --------------------------------------------------
     def submit(
         self,
@@ -609,6 +622,7 @@ class Cluster:
         timeout: float = 300.0,
         retries: int = 2,
         data_args: Sequence = (),
+        meta_sink: Optional[Callable] = None,
         **kwargs,
     ) -> Future:
         """Run ``fn(worker_ctx, *args, *data_args, **kwargs)`` on a worker.
@@ -658,6 +672,11 @@ class Cluster:
                     continue
                 try:
                     reply = client.call("RunTask", payload, timeout=timeout)
+                    if meta_sink is not None:
+                        try:
+                            meta_sink(0, target, reply.get("exec_s", 0.0))
+                        except Exception:
+                            pass  # stats sink must never fail the task
                     return reply["result"]
                 except grpc.RpcError as exc:
                     code = exc.code()
@@ -729,6 +748,7 @@ class Cluster:
         specs: Sequence[TaskSpec],
         timeout: float = 300.0,
         retries: int = 2,
+        meta_sink: Optional[Callable] = None,
     ) -> List[Future]:
         """Run many tasks with ONE RunTaskBatch envelope per worker.
 
@@ -744,6 +764,11 @@ class Cluster:
         Worker death fails only that worker's envelope; its tasks are
         reassigned to surviving workers (stage tasks are idempotent),
         up to ``retries`` rounds.
+
+        ``meta_sink(spec_index, worker_id, exec_s)`` — optional per-task
+        completion callback carrying the executing worker and its
+        measured task seconds (stage-stats attribution); invoked before
+        the matching future resolves.
         """
         futures: List[Future] = [Future() for _ in specs]
         if not specs:
@@ -755,7 +780,9 @@ class Cluster:
         def orchestrate():
             with _prop.propagated(trace_ctx):
                 try:
-                    self._run_batch(list(specs), futures, timeout, retries)
+                    self._run_batch(
+                        list(specs), futures, timeout, retries, meta_sink
+                    )
                 except BaseException as exc:  # noqa: BLE001 - fan to futures
                     for f in futures:
                         if not f.done():
@@ -770,6 +797,7 @@ class Cluster:
         futures: List[Future],
         timeout: float,
         retries: int,
+        meta_sink: Optional[Callable] = None,
     ) -> None:
         staged = [self._stage_data_args(s.data_args) for s in specs]
         try:
@@ -813,6 +841,13 @@ class Cluster:
                         raise outcome
                     for i, res in zip(idxs, outcome):
                         if res.get("ok"):
+                            if meta_sink is not None:
+                                try:
+                                    meta_sink(
+                                        i, wid, res.get("exec_s", 0.0)
+                                    )
+                                except Exception:
+                                    pass  # sink must never fail the batch
                             futures[i].set_result(res.get("value"))
                         else:
                             futures[i].set_exception(

@@ -103,6 +103,7 @@ class AppMaster:
             "ClusterResources": self._on_cluster_resources,
             "MetricsSnapshot": self._on_metrics_snapshot,
             "HealthReport": self._on_health_report,
+            "ProgressReport": self._on_progress_report,
             "Ping": lambda req: {"pong": True, "namespace": self.namespace},
         }
         # The master doubles as the driver node's store agent (no extra
@@ -326,6 +327,21 @@ class AppMaster:
     def _on_health_report(self, req: dict) -> dict:
         return {"report": self.health_report()}
 
+    def _on_progress_report(self, req: dict) -> dict:
+        return {"report": self.progress_report()}
+
+    def progress_report(self) -> dict:
+        """Live stage progress: the driver-process tracker (DataFrame
+        stages run driver-side; workers only execute their tasks) plus
+        recent completed-stage stats from the stage store."""
+        from raydp_tpu.telemetry.progress import progress, stage_store
+
+        report = progress.report()
+        store_snap = stage_store.snapshot()
+        report["stage_totals"] = store_snap["totals"]
+        report["recent_stage_stats"] = store_snap["stages"][-16:]
+        return report
+
     def health_report(self) -> dict:
         """Aggregated cluster health: per-worker heartbeat age + stall
         flags, plus slowest-rank attribution from the merged timers.
@@ -399,7 +415,11 @@ class AppMaster:
         included), the cross-worker aggregate, lifecycle events, and
         this (driver) process's own registry under ``"driver"``."""
         from raydp_tpu.utils.profiling import metrics as _m
+        from raydp_tpu.utils.profiling import sample_resource_gauges
 
+        # Refresh the driver's resource gauges at snapshot time (worker
+        # gauges arrive pre-sampled on their heartbeats).
+        sample_resource_gauges()
         view = self.telemetry.merged()
         view["driver"] = _m.snapshot()
         return view

@@ -197,6 +197,7 @@ class Worker:
             # bracket is the watchdog's stall signal: a wedged task
             # body shows up as component "worker/task" — at the long-op
             # threshold, since a healthy task may run for minutes.
+            t0 = time.perf_counter()
             with _watchdog.inflight(
                 "worker/task", worker_id=self.worker_id,
                 stall_after_s=_watchdog.long_stall_s(),
@@ -205,7 +206,10 @@ class Worker:
                     with metrics.timer("worker/task").time():
                         result = fn(self.ctx, *args, *data, **kwargs)
             _flight.record("task", "end", worker_id=self.worker_id)
-            return {"result": result}
+            # exec_s lets the driver split stage wall into queue vs
+            # execution (stage-stats attribution) with no extra RPC.
+            return {"result": result,
+                    "exec_s": time.perf_counter() - t0}
         except Exception:
             # Let RpcServer._wrap serialize the failure uniformly.
             raise
@@ -259,11 +263,13 @@ class Worker:
                     args = task.get("args", ())
                     kwargs = task.get("kwargs", {})
                     data = self._resolve_data_refs(task.get("data_refs", ()))
+                    t0 = time.perf_counter()
                     with trace_prop.propagated(batch_ctx):
                         with span("worker/task", worker_id=self.worker_id):
                             with metrics.timer("worker/task").time():
                                 value = fn(self.ctx, *args, *data, **kwargs)
-                    return {"ok": True, "value": value}
+                    return {"ok": True, "value": value,
+                            "exec_s": time.perf_counter() - t0}
                 except Exception as exc:
                     return {
                         "ok": False,
@@ -325,6 +331,14 @@ class Worker:
         missed = 0
         while not self._stop_event.wait(2.0):
             beat = {"worker_id": self.worker_id}
+            # Refresh resource gauges (RSS, HBM, store occupancy) so the
+            # delta below ships them to the master's merged view.
+            try:
+                from raydp_tpu.utils.profiling import sample_resource_gauges
+
+                sample_resource_gauges()
+            except Exception:
+                pass
             delta = self._shipper.delta()
             if delta:
                 beat["metrics"] = delta

@@ -34,6 +34,7 @@ import numpy as np
 from raydp_tpu.native import lib as native
 from raydp_tpu.telemetry import current_context, propagated, span
 from raydp_tpu.telemetry import flight_recorder as _flight
+from raydp_tpu.telemetry import progress as _progress
 from raydp_tpu.telemetry import watchdog as _watchdog
 from raydp_tpu.utils.profiling import metrics
 
@@ -229,6 +230,27 @@ class JaxShardLoader:
         # Hoisted out of the hot loop: meter() takes the registry lock.
         rows_meter = metrics.meter("ingest/rows")
         bytes_meter = metrics.meter("ingest/bytes")
+        # Ingest shows up in /debug/progress like any plan stage: one
+        # stage per epoch, one task per transfer chunk.
+        n_chunks = max(1, -(-n_used // rows_per_chunk)) if n_used else 0
+        prog_id = _progress.stage_store.next_id()
+        _progress.progress.stage_begin(
+            prog_id, f"ingest[epoch {epoch}]", n_chunks
+        )
+        try:
+            yield from self._chunk_iter(
+                epoch, rows_per_chunk, pack, matrix, labels, order, n_used,
+                rows_meter, bytes_meter, prog_id,
+            )
+        finally:
+            # finally (not loop-end): a consumer that stops early —
+            # drop_last, a broken epoch, estimator teardown — closes
+            # the generator, and the stage must not stay "active" in
+            # /debug/progress forever.
+            _progress.progress.stage_end(prog_id)
+
+    def _chunk_iter(self, epoch, rows_per_chunk, pack, matrix, labels,
+                    order, n_used, rows_meter, bytes_meter, prog_id):
         for lo in range(0, n_used, rows_per_chunk):
             hi = min(lo + rows_per_chunk, n_used)
             # The span closes before the yield: a suspended generator must
@@ -259,7 +281,9 @@ class JaxShardLoader:
                 )
             _flight.record("loader", "chunk", epoch=epoch, rank=self._rank,
                            rows=hi - lo)
+            _progress.progress.task_done(prog_id)
             yield chunk
+        _progress.progress.stage_end(prog_id)
 
     def _unpack_device(self, buf, rows: int):
         """On-device recovery of (features, labels) from one packed

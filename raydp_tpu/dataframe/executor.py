@@ -15,9 +15,11 @@ Two backends with one interface:
 """
 from __future__ import annotations
 
+import contextlib
 import itertools
 import os
 import threading
+import time
 from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, List, Optional, Sequence
@@ -27,6 +29,12 @@ import pyarrow as pa
 from raydp_tpu.cluster.cluster import TaskSpec
 from raydp_tpu.store.object_store import ObjectRef, ObjectStore
 from raydp_tpu.telemetry import span
+from raydp_tpu.telemetry.progress import (
+    StageStats,
+    progress,
+    stage_stats_enabled,
+    stage_store,
+)
 from raydp_tpu.utils.profiling import metrics
 
 StageFn = Callable[[pa.Table], pa.Table]
@@ -38,6 +46,158 @@ def _stage_span(op: str, n_parts: int, executor: str):
     the stage's wall time as the query planner experiences it)."""
     metrics.counter_add("df/stages")
     return span("df/stage", op=op, parts=n_parts, executor=executor)
+
+
+# -- per-stage runtime statistics ------------------------------------------
+# The planner names the stage it is about to run (``stage_label``); the
+# executor records a StageStats per stage into the driver-side
+# ``stage_store`` and streams done/total task counts into ``progress``.
+# The label context also collects the stage ids it covered, which is how
+# DataFrame plan nodes re-associate runtime numbers with themselves for
+# EXPLAIN ANALYZE / the future AQE.
+_stage_ctx = threading.local()
+
+
+@contextlib.contextmanager
+def stage_label(label: str):
+    """Name the executor stages run inside this context after the plan
+    node driving them; yields the list of stage ids recorded."""
+    ids: List[int] = []
+    prev = getattr(_stage_ctx, "cur", None)
+    _stage_ctx.cur = (label, ids)
+    try:
+        yield ids
+    finally:
+        _stage_ctx.cur = prev
+
+
+def _part_meta(part: Any) -> "tuple[int, int]":
+    """(rows, bytes) of one partition without materializing it; rows is
+    -1 when unknowable (refs stored without a row count)."""
+    if isinstance(part, ObjectRef):
+        return part.num_rows, part.size
+    if isinstance(part, pa.Table):
+        return part.num_rows, part.nbytes
+    return -1, 0
+
+
+class _StageRecorder:
+    """Accumulates one :class:`StageStats` while a stage runs.
+
+    Cheap when disabled (``RAYDP_TPU_STAGE_STATS=0``): every method
+    no-ops after one boolean check. ``task_meta`` doubles as the
+    ``meta_sink`` callback of ``Cluster.submit_batch``/``submit_async``
+    so worker-side exec seconds and per-worker attribution ride the
+    existing task replies."""
+
+    def __init__(self, op: str, parts_in: Sequence[Any], kind: str,
+                 total_tasks: Optional[int] = None):
+        self.enabled = stage_stats_enabled()
+        cur = getattr(_stage_ctx, "cur", None)
+        self.op = cur[0] if cur else op
+        self._ids_sink = cur[1] if cur else None
+        self.kind = kind
+        self._t0 = time.perf_counter()
+        self._dispatch_s = 0.0
+        self._exec_s = 0.0
+        self._workers: dict = {}
+        self._mu = threading.Lock()
+        self._outs: Optional[List[Any]] = None
+        self.stage_id = 0
+        if not self.enabled:
+            return
+        self.stage_id = stage_store.next_id()
+        rows = nbytes = 0
+        for p in parts_in:
+            r, b = _part_meta(p)
+            if r > 0:
+                rows += r
+            nbytes += b
+        self._rows_in, self._bytes_in = rows, nbytes
+        self._parts_in = len(parts_in)
+        total = total_tasks if total_tasks is not None else len(parts_in)
+        progress.stage_begin(self.stage_id, self.op, total)
+
+    def dispatched(self) -> None:
+        """Mark the end of driver-side submission (dispatch time)."""
+        if self.enabled:
+            self._dispatch_s = time.perf_counter() - self._t0
+
+    def task_meta(self, index: int, worker_id: Optional[str],
+                  exec_s: float) -> None:
+        """Per-task completion: worker attribution + measured exec
+        seconds (``meta_sink`` shape)."""
+        if not self.enabled:
+            return
+        with self._mu:
+            self._exec_s += float(exec_s or 0.0)
+            wid = worker_id or "?"
+            self._workers[wid] = self._workers.get(wid, 0) + 1
+        progress.task_done(self.stage_id)
+
+    def task_done(self, n: int = 1) -> None:
+        if self.enabled:
+            progress.task_done(self.stage_id, n)
+
+    def finish(self, parts_out: Sequence[Any]) -> None:
+        if self.enabled:
+            self._outs = list(parts_out)
+
+    def close(self) -> None:
+        if not self.enabled:
+            return
+        wall = time.perf_counter() - self._t0
+        part_rows: List[int] = []
+        part_bytes: List[int] = []
+        rows_out = bytes_out = 0
+        for p in self._outs or ():
+            r, b = _part_meta(p)
+            part_rows.append(r)
+            part_bytes.append(b)
+            if r > 0:
+                rows_out += r
+            bytes_out += b
+        # Queue time: stage wall minus driver dispatch minus measured
+        # worker execution — the time tasks sat waiting for a slot.
+        queue_s = max(0.0, wall - self._dispatch_s - self._exec_s)
+        stats = StageStats(
+            stage_id=self.stage_id,
+            op=self.op,
+            executor=self.kind,
+            rows_in=self._rows_in,
+            rows_out=rows_out,
+            bytes_in=self._bytes_in,
+            bytes_out=bytes_out,
+            parts_in=self._parts_in,
+            parts_out=len(self._outs or ()),
+            wall_s=wall,
+            dispatch_s=self._dispatch_s,
+            queue_s=queue_s if self.kind == "cluster" else 0.0,
+            workers=dict(self._workers),
+            part_rows=part_rows,
+            part_bytes=part_bytes,
+        )
+        stage_store.record(stats)
+        progress.stage_end(self.stage_id)
+        if self._ids_sink is not None:
+            self._ids_sink.append(self.stage_id)
+        metrics.counter_add(f"stage/rows_in/{self.op}", self._rows_in)
+        metrics.counter_add(f"stage/rows_out/{self.op}", rows_out)
+        metrics.counter_add(f"stage/bytes_in/{self.op}", self._bytes_in)
+        metrics.counter_add(f"stage/bytes_out/{self.op}", bytes_out)
+        metrics.counter_add(f"stage/seconds/{self.op}", wall)
+
+
+@contextlib.contextmanager
+def _stage(op: str, parts_in: Sequence[Any], executor: str,
+           total_tasks: Optional[int] = None):
+    """Span + counter + StageStats recording around one stage."""
+    rec = _StageRecorder(op, parts_in, executor, total_tasks)
+    with _stage_span(op, len(parts_in), executor):
+        try:
+            yield rec
+        finally:
+            rec.close()
 
 # Memoized gather-concat for coalesced runs (Spark's analog: shuffle
 # block reuse). Interactive ETL re-runs queries over the SAME stored
@@ -212,21 +372,45 @@ class LocalExecutor(Executor):
         )
 
     def map_partitions(self, parts, fn):
-        with _stage_span("map_partitions", len(parts), "local"):
-            return list(self._pool.map(fn, parts))
+        with _stage("map_partitions", parts, "local") as rec:
+            def run(t):
+                out = fn(t)
+                rec.task_done()
+                return out
+
+            outs = list(self._pool.map(run, parts))
+            rec.finish(outs)
+            return outs
 
     def map_partitions_indexed(self, parts, fn):
-        with _stage_span("map_partitions_indexed", len(parts), "local"):
-            return list(self._pool.map(fn, parts, range(len(parts))))
+        with _stage("map_partitions_indexed", parts, "local") as rec:
+            def run(t, i):
+                out = fn(t, i)
+                rec.task_done()
+                return out
+
+            outs = list(self._pool.map(run, parts, range(len(parts))))
+            rec.finish(outs)
+            return outs
 
     def map_pairs(self, parts_a, parts_b, fn):
-        with _stage_span("map_pairs", len(parts_a), "local"):
-            return list(self._pool.map(fn, parts_a, parts_b))
+        with _stage("map_pairs", parts_a, "local") as rec:
+            def run(ta, tb):
+                out = fn(ta, tb)
+                rec.task_done()
+                return out
+
+            outs = list(self._pool.map(run, parts_a, parts_b))
+            rec.finish(outs)
+            return outs
 
     def exchange(self, parts, splitter, n_out, combine=None):
-        with _stage_span("exchange", len(parts), "local"):
+        with _stage("exchange", parts, "local",
+                    total_tasks=len(parts) + n_out) as rec:
             metrics.counter_add("shuffle/exchanges")
             chunked = list(self._pool.map(splitter, parts))
+            rec.task_done(len(parts))
+            rec.dispatched()
             moved = sum(
                 c.nbytes for chunks in chunked for c in chunks
             )
@@ -237,6 +421,8 @@ class LocalExecutor(Executor):
             for i in range(n_out):
                 merged = _concat([chunks[i] for chunks in chunked])
                 outs.append(combine(merged) if combine else merged)
+                rec.task_done()
+            rec.finish(outs)
             return outs
 
     def part_nbytes(self, part):
@@ -244,11 +430,14 @@ class LocalExecutor(Executor):
 
     def run_coalesced(self, parts, fn, pre_concat=False):
         parts = list(parts)
-        with _stage_span("run_coalesced", len(parts), "local"):
+        with _stage("run_coalesced", parts, "local", total_tasks=1) as rec:
             if not pre_concat:
-                return fn(parts)
-            key = ("local",) + tuple(id(t) for t in parts)
-            return fn(_concat_cached(parts, key, keepalive=parts))
+                out = fn(parts)
+            else:
+                key = ("local",) + tuple(id(t) for t in parts)
+                out = fn(_concat_cached(parts, key, keepalive=parts))
+            rec.finish([out] if isinstance(out, pa.Table) else [])
+            return out
 
     def materialize(self, part):
         return part
@@ -310,27 +499,33 @@ class ClusterExecutor(Executor):
             table = ctx.get_table(ref)
             return ctx.put_table(fn(table), holder=True)
 
-        with _stage_span("map_partitions", len(parts), "cluster"):
+        with _stage("map_partitions", parts, "cluster") as rec:
             # One RunTaskBatch envelope per worker (not per partition):
             # per-call gRPC+pickle overhead amortizes over all of that
             # worker's partitions, and fn serializes once per envelope.
             futures = self.cluster.submit_batch([
                 TaskSpec(task, (ref,), worker_id=self._worker_for(i, ref))
                 for i, ref in enumerate(parts)
-            ])
-            return [f.result() for f in futures]
+            ], meta_sink=rec.task_meta)
+            rec.dispatched()
+            outs = [f.result() for f in futures]
+            rec.finish(outs)
+            return outs
 
     def map_partitions_indexed(self, parts, fn):
         def task(ctx, ref, index):
             table = ctx.get_table(ref)
             return ctx.put_table(fn(table, index), holder=True)
 
-        with _stage_span("map_partitions_indexed", len(parts), "cluster"):
+        with _stage("map_partitions_indexed", parts, "cluster") as rec:
             futures = self.cluster.submit_batch([
                 TaskSpec(task, (ref, i), worker_id=self._worker_for(i, ref))
                 for i, ref in enumerate(parts)
-            ])
-            return [f.result() for f in futures]
+            ], meta_sink=rec.task_meta)
+            rec.dispatched()
+            outs = [f.result() for f in futures]
+            rec.finish(outs)
+            return outs
 
     def part_nbytes(self, part):
         return part.size if isinstance(part, ObjectRef) else part.nbytes
@@ -372,10 +567,15 @@ class ClusterExecutor(Executor):
             if workers:
                 worker_id = workers[0]
         parts = list(parts)
-        with _stage_span("run_coalesced", len(parts), "cluster"):
-            return self.cluster.submit_async(
-                task, parts, worker_id=worker_id
-            ).result()
+        with _stage("run_coalesced", parts, "cluster",
+                    total_tasks=1) as rec:
+            fut = self.cluster.submit_async(
+                task, parts, worker_id=worker_id, meta_sink=rec.task_meta
+            )
+            rec.dispatched()
+            out = fut.result()
+            rec.finish([out])
+            return out
 
     def map_pairs(self, parts_a, parts_b, fn):
         def task(ctx, ra, rb):
@@ -383,12 +583,15 @@ class ClusterExecutor(Executor):
             tb = ctx.get_table(rb)
             return ctx.put_table(fn(ta, tb), holder=True)
 
-        with _stage_span("map_pairs", len(parts_a), "cluster"):
+        with _stage("map_pairs", parts_a, "cluster") as rec:
             futures = self.cluster.submit_batch([
                 TaskSpec(task, (ra, rb), worker_id=self._worker_for(i, ra))
                 for i, (ra, rb) in enumerate(zip(parts_a, parts_b))
-            ])
-            return [f.result() for f in futures]
+            ], meta_sink=rec.task_meta)
+            rec.dispatched()
+            outs = [f.result() for f in futures]
+            rec.finish(outs)
+            return outs
 
     def _free_refs(self, refs) -> None:
         for ref in refs:
@@ -455,13 +658,14 @@ class ClusterExecutor(Executor):
         except ValueError:
             eager_min = 0
 
-        with _stage_span("exchange", len(parts), "cluster"):
+        with _stage("exchange", parts, "cluster",
+                    total_tasks=len(parts) + n_out) as rec:
             metrics.counter_add("shuffle/exchanges")
             split_futures = self.cluster.submit_batch([
                 TaskSpec(split_task, (ref,),
                          worker_id=self._worker_for(i, ref))
                 for i, ref in enumerate(parts)
-            ])
+            ], meta_sink=rec.task_meta)
             # Stream split completions (one envelope per worker resolves
             # independently) instead of gathering in submission order:
             # merge planning starts the moment the last chunk EXISTS,
@@ -524,7 +728,10 @@ class ClusterExecutor(Executor):
                 merge_inputs.append(refs)
             metrics.counter_add("shuffle/bytes", total_b)
             metrics.counter_add("shuffle/local_bytes", local_b)
-            merge_futures = self.cluster.submit_batch(specs)
+            merge_futures = self.cluster.submit_batch(
+                specs, meta_sink=rec.task_meta
+            )
+            rec.dispatched()
             # Merge i consumes exactly its input refs, so they are dead
             # the moment that merge lands — free them then, instead of
             # holding the whole shuffle's intermediates until the full
@@ -534,7 +741,9 @@ class ClusterExecutor(Executor):
                 f.add_done_callback(
                     lambda fut, rr=refs: self._free_refs(rr)
                 )
-            return [f.result() for f in merge_futures]
+            outs = [f.result() for f in merge_futures]
+            rec.finish(outs)
+            return outs
 
     def materialize(self, part):
         return self.cluster.resolver.get_arrow_table(part)

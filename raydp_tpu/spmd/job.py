@@ -433,6 +433,41 @@ class SPMDJob:
         """Merged per-rank metrics view (heartbeat-shipped deltas)."""
         return self.telemetry.merged()
 
+    def resource_report(self) -> dict:
+        """Per-rank resource accounting from the shipped gauges: host
+        RSS, device HBM used/peak, plus XLA compile counters — the
+        training-side face of the query-profiling plane. Ranks that have
+        not yet shipped gauges appear with empty dicts."""
+        view = self.telemetry.merged()
+        ranks = {}
+        for rid, sections in sorted((view.get("workers") or {}).items()):
+            gauges = sections.get("gauges") or {}
+            counters = sections.get("counters") or {}
+            ranks[rid] = {
+                "rss_bytes": gauges.get("mem/rss_bytes", 0),
+                "rss_peak_bytes": gauges.get("mem/rss_peak_bytes", 0),
+                "hbm_used_bytes": gauges.get("hbm/used_bytes", 0),
+                "hbm_peak_bytes": gauges.get("hbm/peak_bytes", 0),
+                "compiles": counters.get("compile/count", 0),
+                "compile_seconds": counters.get("compile/seconds", 0.0),
+                "compile_failures": counters.get("compile/failures", 0),
+            }
+        agg = view.get("aggregate") or {}
+        agg_gauges = agg.get("gauges") or {}
+        agg_counters = agg.get("counters") or {}
+        return {
+            "ranks": ranks,
+            "totals": {
+                "rss_bytes": agg_gauges.get("mem/rss_bytes", 0),
+                "hbm_used_bytes": agg_gauges.get("hbm/used_bytes", 0),
+                "hbm_peak_bytes": agg_gauges.get("hbm/peak_bytes", 0),
+                "compiles": agg_counters.get("compile/count", 0),
+                "compile_seconds": agg_counters.get(
+                    "compile/seconds", 0.0
+                ),
+            },
+        }
+
     def health_report(self) -> dict:
         """Gang health: per-rank stall flags shipped on Pings, plus job
         failure state (parity with ``Cluster.health_report``)."""

@@ -197,8 +197,21 @@ class SPMDWorker:
         throughput without a second RPC channel."""
         shipper = MetricsShipper()
         missed = 0
+        # Compile-time accounting for everything this rank jits; the
+        # counters ride the same metric deltas as the step timers.
+        from raydp_tpu.utils.profiling import (
+            install_compile_listener,
+            sample_resource_gauges,
+        )
+
+        install_compile_listener()
         while not self._stop_event.wait(5.0):
             beat = {"rank": self.rank}
+            # HBM used/peak + host RSS for this rank, refreshed per beat.
+            try:
+                sample_resource_gauges()
+            except Exception:
+                pass
             delta = shipper.delta()
             if delta:
                 beat["metrics"] = delta

@@ -241,6 +241,13 @@ class ObjectStore:
         with self._lock:
             return list(self._objects.values())
 
+    def occupancy_bytes(self) -> int:
+        """Bytes of shm this directory currently accounts for (sum of
+        registered object sizes — the store's view, not a /dev/shm
+        scan, so it is cheap enough for heartbeat-rate sampling)."""
+        with self._lock:
+            return sum(r.size for r in self._objects.values())
+
     # -- helpers --------------------------------------------------------
     def _segment_name(self, object_id: str) -> str:
         return f"{self._prefix}{object_id}"

@@ -54,7 +54,17 @@ from raydp_tpu.telemetry.export import (
     telemetry_dir,
     write_events,
 )
-from raydp_tpu.telemetry import flight_recorder, logs, watchdog
+from raydp_tpu.telemetry import flight_recorder, logs, progress, watchdog
+from raydp_tpu.telemetry.progress import (
+    PROGRESS_LOG_ENV,
+    STAGE_STATS_ENV,
+    STATS_DIR_ENV,
+    ProgressTracker,
+    StageStats,
+    StageStatsStore,
+    stage_stats_enabled,
+    stage_store,
+)
 from raydp_tpu.telemetry.flight_recorder import (
     POSTMORTEM_DIR_ENV,
     dump_bundle,

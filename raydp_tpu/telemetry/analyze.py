@@ -16,6 +16,9 @@ Two entry points over the same report dict:
 """
 from __future__ import annotations
 
+import glob
+import json
+import os
 import sys
 from typing import Any, Dict, List, Optional
 
@@ -27,7 +30,13 @@ from raydp_tpu.telemetry.chrome_trace import (
     write_chrome_trace,
 )
 
-__all__ = ["analyze_records", "trace_report", "format_report", "main"]
+__all__ = [
+    "analyze_records",
+    "load_stage_stats",
+    "trace_report",
+    "format_report",
+    "main",
+]
 
 STEP_SPAN = "train/step"
 DATA_SPANS = ("ingest/chunk",)
@@ -179,10 +188,53 @@ def analyze_records(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     }
 
 
+def load_stage_stats(directory: str) -> List[Dict[str, Any]]:
+    """Read every ``stats-*.jsonl`` shard (one dict per executed
+    DataFrame stage, written by :class:`StageStatsStore` when
+    ``RAYDP_TPU_STATS_DIR`` is set) under ``directory``."""
+    stats: List[Dict[str, Any]] = []
+    for path in sorted(glob.glob(os.path.join(directory, "stats-*.jsonl"))):
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        stats.append(json.loads(line))
+        except (OSError, ValueError):
+            continue  # partial shard from a dying process
+    return stats
+
+
+def _stage_summary(stats: List[Dict[str, Any]]) -> Dict[str, Any]:
+    per_op: Dict[str, Dict[str, Any]] = {}
+    for st in stats:
+        agg = per_op.setdefault(st.get("op", "?"), {
+            "stages": 0, "rows_in": 0, "rows_out": 0,
+            "bytes_out": 0, "wall_s": 0.0, "max_skew": 1.0,
+        })
+        agg["stages"] += 1
+        agg["rows_in"] += int(st.get("rows_in", 0))
+        agg["rows_out"] += int(st.get("rows_out", 0))
+        agg["bytes_out"] += int(st.get("bytes_out", 0))
+        agg["wall_s"] = round(agg["wall_s"] + float(st.get("wall_s", 0.0)), 6)
+        agg["max_skew"] = max(agg["max_skew"], float(st.get("skew", 1.0)))
+    return {
+        "stages": len(stats),
+        "wall_s": round(sum(float(s.get("wall_s", 0.0)) for s in stats), 6),
+        "per_op": per_op,
+    }
+
+
 def trace_report(directory: str) -> Dict[str, Any]:
     """Read every ``spans*.jsonl`` shard under ``directory`` and build
-    the analysis report dict (see :func:`format_report` for rendering)."""
-    return analyze_records(load_span_records(directory))
+    the analysis report dict (see :func:`format_report` for rendering).
+    ``stats-*.jsonl`` stage-stat shards in the same directory are folded
+    in as a ``stage_stats`` section."""
+    report = analyze_records(load_span_records(directory))
+    stats = load_stage_stats(directory)
+    if stats:
+        report["stage_stats"] = _stage_summary(stats)
+    return report
 
 
 def format_report(report: Dict[str, Any]) -> str:
@@ -233,6 +285,26 @@ def format_report(report: Dict[str, Any]) -> str:
             f" · compute {entry['compute_s']:.4f}s"
             f" · data-wait {entry['data_frac'] * 100:.1f}%"
         )
+    stage = report.get("stage_stats")
+    if stage:
+        lines += [
+            "",
+            f"dataframe stages: {stage['stages']}"
+            f" · {stage['wall_s']:.4f}s total wall",
+            f"  {'op':<32} {'stages':>6} {'rows out':>12}"
+            f" {'bytes out':>12} {'wall':>10} {'skew':>6}",
+        ]
+        per_op = stage["per_op"]
+        by_wall = sorted(
+            per_op, key=lambda k: per_op[k]["wall_s"], reverse=True
+        )
+        for op in by_wall:
+            agg = per_op[op]
+            lines.append(
+                f"  {op[:32]:<32} {agg['stages']:>6}"
+                f" {agg['rows_out']:>12,} {agg['bytes_out']:>12,}"
+                f" {agg['wall_s']:>9.4f}s {agg['max_skew']:>5.2f}x"
+            )
     return "\n".join(lines)
 
 

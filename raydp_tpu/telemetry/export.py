@@ -228,6 +228,53 @@ def render_prometheus(view: Dict[str, Any]) -> str:
         "Exchanges skipped by the co-partitioning planner because the "
         "frame's existing hash partitioning already co-located the keys.",
     )
+    stage_rows = _Family(
+        "raydp_stage_rows_total", "counter",
+        "Rows entering/leaving DataFrame stages, per plan-node label "
+        "(direction=in|out).",
+    )
+    stage_bytes = _Family(
+        "raydp_stage_bytes_total", "counter",
+        "Arrow bytes entering/leaving DataFrame stages (direction=in|out).",
+    )
+    stage_seconds = _Family(
+        "raydp_stage_seconds_total", "counter",
+        "Wall seconds spent in DataFrame stages, per plan-node label.",
+    )
+    compiles = _Family(
+        "raydp_compiles_total", "counter",
+        "XLA backend compiles observed via jax.monitoring (one per "
+        "top-level compile event).",
+    )
+    compile_seconds = _Family(
+        "raydp_compile_seconds_total", "counter",
+        "Cumulative XLA compile seconds (all compile-phase duration "
+        "events summed).",
+    )
+    compile_failures = _Family(
+        "raydp_compile_failures_total", "counter",
+        "XLA compiles that raised (remote-compile HTTP errors included).",
+    )
+    host_rss = _Family(
+        "raydp_host_rss_bytes", "gauge",
+        "Host resident-set size per process (kind=current|peak; peak is "
+        "the VmHWM watermark).",
+    )
+    hbm_bytes = _Family(
+        "raydp_hbm_bytes", "gauge",
+        "Device HBM bytes summed over the process's local jax devices "
+        "(kind=used|peak).",
+    )
+    store_occupancy = _Family(
+        "raydp_store_occupancy_bytes", "gauge",
+        "Shm object-store bytes registered in this process's store "
+        "(kind=current|peak).",
+    )
+    gauges = _Family(
+        "raydp_gauge", "gauge",
+        "MetricsRegistry gauges without a dedicated family, one series "
+        "per (worker, name).",
+    )
 
     sources: Dict[str, Dict[str, Any]] = dict(view.get("workers") or {})
     driver = view.get("driver")
@@ -279,9 +326,74 @@ def render_prometheus(view: Dict[str, Any]) -> str:
                             {"worker": worker_id}, section[name]
                         )
                         continue
+                    if name.startswith("stage/"):
+                        # Per-stage runtime stats recorded by the
+                        # DataFrame executors: stage/<kind>/<op label>.
+                        _, kind, op = name.split("/", 2)
+                        if kind in ("rows_in", "rows_out"):
+                            stage_rows.add(
+                                {"worker": worker_id, "op": op,
+                                 "direction": kind[5:]},
+                                section[name],
+                            )
+                            continue
+                        if kind in ("bytes_in", "bytes_out"):
+                            stage_bytes.add(
+                                {"worker": worker_id, "op": op,
+                                 "direction": kind[6:]},
+                                section[name],
+                            )
+                            continue
+                        if kind == "seconds":
+                            stage_seconds.add(
+                                {"worker": worker_id, "op": op},
+                                section[name],
+                            )
+                            continue
+                    if name == "compile/count":
+                        compiles.add({"worker": worker_id}, section[name])
+                        continue
+                    if name == "compile/seconds":
+                        compile_seconds.add(
+                            {"worker": worker_id}, section[name]
+                        )
+                        continue
+                    if name == "compile/failures":
+                        compile_failures.add(
+                            {"worker": worker_id}, section[name]
+                        )
+                        continue
                     counters.add(
                         {"worker": worker_id, "name": name}, section[name]
                     )
+            elif key == "gauges":
+                for name in sorted(section):
+                    value = section[name]
+                    if name in ("mem/rss_bytes", "mem/rss_peak_bytes"):
+                        host_rss.add(
+                            {"worker": worker_id,
+                             "kind": "peak" if "peak" in name
+                             else "current"},
+                            value,
+                        )
+                    elif name in ("hbm/used_bytes", "hbm/peak_bytes"):
+                        hbm_bytes.add(
+                            {"worker": worker_id,
+                             "kind": "peak" if "peak" in name else "used"},
+                            value,
+                        )
+                    elif name in ("store/occupancy_bytes",
+                                  "store/occupancy_peak_bytes"):
+                        store_occupancy.add(
+                            {"worker": worker_id,
+                             "kind": "peak" if "peak" in name
+                             else "current"},
+                            value,
+                        )
+                    else:
+                        gauges.add(
+                            {"worker": worker_id, "name": name}, value
+                        )
             elif key.startswith("meter/"):
                 labels = {"worker": worker_id, "name": key[len("meter/"):]}
                 meter_total.add(labels, section.get("total", 0.0))
@@ -299,7 +411,9 @@ def render_prometheus(view: Dict[str, Any]) -> str:
     lines: List[str] = []
     for family in (up, counters, meter_total, meter_rate, timers, dropped,
                    stalls, rpc_payload, shuffle_bytes, shuffle_local,
-                   shuffles_elided):
+                   shuffles_elided, stage_rows, stage_bytes, stage_seconds,
+                   compiles, compile_seconds, compile_failures, host_rss,
+                   hbm_bytes, store_occupancy, gauges):
         lines.extend(family.render())
     return "\n".join(lines) + ("\n" if lines else "")
 
@@ -349,11 +463,21 @@ def _debug_state(health: Callable[[], Dict[str, Any]]) -> Dict[str, Any]:
     }
 
 
+def _default_progress() -> Dict[str, Any]:
+    from raydp_tpu.telemetry.progress import progress as _progress
+    from raydp_tpu.telemetry.progress import stage_store as _stage_store
+
+    report = _progress.report()
+    report["stage_totals"] = _stage_store.snapshot()["totals"]
+    return report
+
+
 def serve_prometheus(
     render: Callable[[], str],
     port: int,
     host: str = "0.0.0.0",
     health: Optional[Callable[[], Dict[str, Any]]] = None,
+    progress: Optional[Callable[[], Dict[str, Any]]] = None,
 ) -> _ScrapeServer:
     """Serve the process debug surface on a daemon thread.
 
@@ -365,13 +489,17 @@ def serve_prometheus(
     ``/healthz`` (JSON from ``health()`` — default: the local watchdog
     — with status 503 when unhealthy, the k8s *readiness* target),
     ``/debug/state`` (health + flight-recorder tail + metrics
-    snapshot), and ``/debug/stacks`` (plain-text all-thread dump).
+    snapshot), ``/debug/stacks`` (plain-text all-thread dump), and
+    ``/debug/progress`` (JSON from ``progress()`` — default: the
+    process's live :mod:`~raydp_tpu.telemetry.progress` tracker plus
+    stage-store totals).
     Stdlib ``http.server`` only: one scrape every few seconds, no need
     for more. ``port=0`` binds an ephemeral port. Returns a handle with
     ``.port`` and idempotent ``.close()``."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     health_fn = health if health is not None else _default_health
+    progress_fn = progress if progress is not None else _default_progress
 
     class Handler(BaseHTTPRequestHandler):
         def _reply(self, code: int, body: bytes, ctype: str) -> None:
@@ -416,6 +544,14 @@ def serve_prometheus(
                         ).encode("utf-8"),
                         "application/json",
                     )
+                elif path == "/debug/progress":
+                    self._reply(
+                        200,
+                        json.dumps(
+                            progress_fn(), default=str
+                        ).encode("utf-8"),
+                        "application/json",
+                    )
                 elif path == "/debug/stacks":
                     from raydp_tpu.telemetry import flight_recorder as _fl
 
@@ -447,7 +583,8 @@ def serve_prometheus(
     # port=0 callers learn the ephemeral port here (and via .port).
     logger.info(
         "telemetry debug endpoint on %s:%d "
-        "(/metrics /livez /healthz /debug/state /debug/stacks)",
+        "(/metrics /livez /healthz /debug/state /debug/stacks "
+        "/debug/progress)",
         host, server.port,
     )
     return server

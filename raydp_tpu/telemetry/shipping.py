@@ -140,6 +140,12 @@ class ClusterTelemetry:
                     agg = aggregate.setdefault("counters", {})
                     for name, value in section.items():
                         agg[name] = agg.get(name, 0.0) + value
+                elif key == "gauges":
+                    # Resource gauges (RSS, HBM, store occupancy) sum
+                    # across workers: the cluster-wide footprint.
+                    agg = aggregate.setdefault("gauges", {})
+                    for name, value in section.items():
+                        agg[name] = agg.get(name, 0.0) + value
                 elif key.startswith("timer/"):
                     agg = aggregate.setdefault(key, {})
                     for stat, value in section.items():

@@ -34,6 +34,36 @@ from raydp_tpu.train.losses import resolve_loss, resolve_metric
 logger = logging.getLogger(__name__)
 
 
+def _guard_compile(jitted: Callable, label: str) -> Callable:
+    """Surface first-dispatch (compile-time) failures with XLA detail.
+
+    The first call of a jit'd step is where tracing + backend compile
+    happen; an opaque failure there (the remote-compile HTTP 500 being
+    the classic) would otherwise reach the user with no hint of which
+    step, how long the compile ran, or what the service said. Later
+    calls pass through untouched — runtime errors are not compile
+    errors and must not be relabelled as such.
+    """
+    state = {"first": True}
+
+    def wrapped(*args, **kwargs):
+        if not state["first"]:
+            return jitted(*args, **kwargs)
+        from raydp_tpu.utils.profiling import enrich_compile_error
+
+        start = time.monotonic()
+        try:
+            out = jitted(*args, **kwargs)
+        except Exception as exc:
+            raise enrich_compile_error(
+                exc, time.monotonic() - start, label
+            ) from exc
+        state["first"] = False
+        return out
+
+    return wrapped
+
+
 class TrainingCallback:
     """Per-epoch hook (reference: TorchEstimator's TrainingCallback /
     train.report, torch/estimator.py:220-224,272-274)."""
@@ -292,9 +322,9 @@ class JAXEstimator:
             )
         else:
             shardings = self.replicated
-        self._state = jax.jit(
+        self._state = _guard_compile(jax.jit(
             lambda: nn.unbox(create()), out_shardings=shardings
-        )()
+        ), "init_state")()
         self._state_shardings = shardings
         self._build_steps()
 
@@ -361,11 +391,19 @@ class JAXEstimator:
                 preds = state.apply_fn(state.params, x)
             return preds
 
-        self._train_step = jax.jit(
+        # Compile accounting: every backend compile these steps trigger
+        # lands in compile/count + compile/seconds (shipped on
+        # heartbeats, exported as raydp_compile_* families).
+        from raydp_tpu.utils.profiling import install_compile_listener
+
+        install_compile_listener()
+        self._train_step = _guard_compile(jax.jit(
             train_step, donate_argnums=(0,) if self.donate_state else ()
+        ), "train_step")
+        self._eval_step = _guard_compile(jax.jit(eval_step), "eval_step")
+        self._predict_step = _guard_compile(
+            jax.jit(predict_step), "predict_step"
         )
-        self._eval_step = jax.jit(eval_step)
-        self._predict_step = jax.jit(predict_step)
 
     def _model_takes_deterministic(self) -> bool:
         import inspect
@@ -769,9 +807,9 @@ class JAXEstimator:
 
         # Honor donate_state here too: with donation off a callback may
         # safely hold a reference to the previous epoch's state.
-        return jax.jit(
+        return _guard_compile(jax.jit(
             epoch_fn, donate_argnums=(0,) if self.donate_state else ()
-        )
+        ), "scan_epoch")
 
     def _fit_scan(
         self,

@@ -1,9 +1,17 @@
-"""Human-readable memory size parsing/formatting.
+"""Human-readable memory size parsing/formatting + host RSS sampling.
 
 Capability parity with the reference's memory-string handling
 (reference: python/raydp/utils.py:125-146 ``parse_memory_size``): accepts
 "500M", "500MB", "1.5 GB", "2g", plain integers ("1024"), case-insensitive,
 optional space between number and unit.
+
+The RSS helpers feed the resource-accounting gauges of the query
+profiling plane (``raydp_host_rss_bytes``): :func:`host_rss_bytes`
+reads the current and peak resident set from ``/proc/self/status``
+(``VmRSS`` / ``VmHWM``), falling back to ``resource.getrusage`` where
+procfs is unavailable; :func:`reset_peak_rss` arms a fresh peak window
+via ``/proc/self/clear_refs`` so per-section watermarks (bench configs)
+don't inherit an earlier section's high-water mark.
 """
 from __future__ import annotations
 
@@ -51,3 +59,44 @@ def format_memory_size(num_bytes: int) -> str:
             text = f"{value:.1f}".rstrip("0").rstrip(".")
             return f"{text}{unit}B"
     return f"{num_bytes}B"
+
+
+def host_rss_bytes() -> "tuple[int, int]":
+    """Return ``(rss_bytes, peak_rss_bytes)`` for this process.
+
+    Prefers ``/proc/self/status`` (``VmRSS``/``VmHWM``) so the peak is
+    resettable via :func:`reset_peak_rss`; falls back to
+    ``resource.getrusage`` (``ru_maxrss`` is the lifetime peak and
+    stands in for both values) where procfs is missing."""
+    try:
+        rss = peak = 0
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    rss = int(line.split()[1]) * 1024
+                elif line.startswith("VmHWM:"):
+                    peak = int(line.split()[1]) * 1024
+        if rss or peak:
+            return rss, max(rss, peak)
+    except OSError:
+        pass
+    try:
+        import resource
+
+        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+        return peak, peak
+    except Exception:
+        return 0, 0
+
+
+def reset_peak_rss() -> bool:
+    """Reset the kernel's peak-RSS watermark (``VmHWM``) for this
+    process so the next :func:`host_rss_bytes` peak covers a fresh
+    window. Returns False where unsupported (non-Linux, no write
+    permission) — callers then get the lifetime peak instead."""
+    try:
+        with open("/proc/self/clear_refs", "w") as f:
+            f.write("5")
+        return True
+    except OSError:
+        return False

@@ -31,6 +31,9 @@ __all__ = [
     "metrics",
     "trace",
     "annotate",
+    "install_compile_listener",
+    "enrich_compile_error",
+    "sample_resource_gauges",
 ]
 
 
@@ -141,10 +144,23 @@ class MetricsRegistry:
     _counters: Dict[str, float] = field(default_factory=dict)
     _timers: Dict[str, StepTimer] = field(default_factory=dict)
     _meters: Dict[str, ThroughputMeter] = field(default_factory=dict)
+    _gauges: Dict[str, float] = field(default_factory=dict)
 
     def counter_add(self, name: str, value: float = 1.0) -> None:
         with self._lock:
             self._counters[name] = self._counters.get(name, 0.0) + value
+
+    def gauge_set(self, name: str, value: float) -> None:
+        """Point-in-time value (RSS, HBM in use, store occupancy)."""
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def gauge_max(self, name: str, value: float) -> None:
+        """Watermark gauge: keeps the max ever observed."""
+        with self._lock:
+            prev = self._gauges.get(name)
+            if prev is None or value > prev:
+                self._gauges[name] = float(value)
 
     def timer(self, name: str) -> StepTimer:
         with self._lock:
@@ -163,6 +179,10 @@ class MetricsRegistry:
             out: Dict[str, Dict[str, float]] = {
                 "counters": dict(self._counters)
             }
+            # Omitted when empty so pre-gauge snapshot shapes (and the
+            # exposition goldens built on them) are unchanged.
+            if self._gauges:
+                out["gauges"] = dict(self._gauges)
             for name, t in self._timers.items():
                 out[f"timer/{name}"] = t.summary()
             for name, m in self._meters.items():
@@ -174,6 +194,7 @@ class MetricsRegistry:
             self._counters.clear()
             self._timers.clear()
             self._meters.clear()
+            self._gauges.clear()
 
 
 metrics = MetricsRegistry()
@@ -200,3 +221,143 @@ def annotate(name: str) -> Iterator[None]:
 
     with jax.profiler.TraceAnnotation(name):
         yield
+
+
+# -- XLA compile accounting ------------------------------------------------
+_COMPILE_LISTENER_INSTALLED = False
+
+
+def install_compile_listener() -> bool:
+    """Feed XLA compile durations into ``compile/count`` +
+    ``compile/seconds`` via ``jax.monitoring``.
+
+    Every backend-compile jax performs (jit tracing-triggered, AOT
+    ``.compile()``, remote TPU compile) emits a ``*compile*`` duration
+    event; counting them here gives compile-time accounting on every
+    process — driver, SPMD ranks, cluster workers — without wrapping
+    individual ``jax.jit`` sites. Idempotent; returns False when the
+    running jax has no monitoring hooks."""
+    global _COMPILE_LISTENER_INSTALLED
+    if _COMPILE_LISTENER_INSTALLED:
+        return True
+    try:
+        from jax import monitoring as _mon
+
+        def _on_duration(event: str, duration: float, **kw) -> None:
+            if "compile" not in event:
+                return
+            # Count the top-level backend_compile events once; finer
+            # sub-phase events still add their seconds to the total.
+            if "backend_compile" in event or event.endswith(
+                "compile_duration_sec"
+            ):
+                metrics.counter_add("compile/count")
+            metrics.counter_add("compile/seconds", float(duration))
+
+        _mon.register_event_duration_secs_listener(_on_duration)
+    except Exception:
+        return False
+    _COMPILE_LISTENER_INSTALLED = True
+    return True
+
+
+_REMOTE_COMPILE_RE = None
+
+
+def enrich_compile_error(
+    exc: BaseException, duration_s: float, label: str
+) -> RuntimeError:
+    """Build an actionable error for a failed XLA compile.
+
+    Remote-compile failures surface as an opaque
+    ``INTERNAL: http://...:PORT/remote_compile: HTTP 500:
+    tpu_compile_helper subprocess exit code N`` with none of the
+    compiler's own diagnostics. Wrap them (and any other compile-time
+    failure) with the compile duration, the phase label, and every line
+    of XLA/compiler detail present in the original text, so the log
+    carries what the HTTP 500 swallowed. Chain with ``raise ... from
+    exc`` at the call site to keep the original traceback."""
+    global _REMOTE_COMPILE_RE
+    if _REMOTE_COMPILE_RE is None:
+        import re
+
+        _REMOTE_COMPILE_RE = re.compile(
+            r"(https?://\S+/remote_compile):\s*HTTP (\d+)(?::\s*(.*))?",
+            re.DOTALL,
+        )
+    text = str(exc)
+    lines = [
+        f"XLA compilation failed in {label!r} after {duration_s:.1f}s"
+        f" ({type(exc).__name__})."
+    ]
+    m = _REMOTE_COMPILE_RE.search(text)
+    if m:
+        endpoint, status, body = m.group(1), m.group(2), m.group(3)
+        lines.append(
+            f"The compile was served remotely by {endpoint} which"
+            f" returned HTTP {status} — the compiler error below is"
+            " everything the compile service reported:"
+        )
+        detail = (body or "").strip()
+        lines.append(f"  {detail if detail else '(no body)'}")
+        lines.append(
+            "Likely causes: the program is too large for the compile"
+            " helper (seen at seq>=16384 dense attention — shrink the"
+            " per-stage program or use flash attention), or the helper"
+            " OOM-killed; retry with a smaller shape to confirm."
+        )
+    else:
+        lines.append(f"Compiler said: {text.strip() or '(empty message)'}")
+    err = RuntimeError("\n".join(lines))
+    metrics.counter_add("compile/failures")
+    metrics.counter_add("compile/seconds", duration_s)
+    return err
+
+
+def sample_resource_gauges(registry: Optional[MetricsRegistry] = None) -> None:
+    """Refresh the resource-accounting gauges on ``registry`` (default:
+    the process registry): host RSS current/peak, per-process device HBM
+    in-use/peak summed over local devices, and shm object-store
+    occupancy when a store is live in this process. Called from worker
+    heartbeats / SPMD pings / driver snapshots — cheap enough for a 2s
+    cadence (one procfs read + dict lookups)."""
+    reg = registry if registry is not None else metrics
+    from raydp_tpu.utils.memory import host_rss_bytes
+
+    rss, peak = host_rss_bytes()
+    if rss:
+        reg.gauge_set("mem/rss_bytes", rss)
+        reg.gauge_max("mem/rss_peak_bytes", peak)
+    try:
+        import sys
+
+        jax = sys.modules.get("jax")  # never import-triggers a backend
+        if jax is not None:
+            used = hwm = 0
+            have = False
+            for dev in jax.local_devices():
+                stats = dev.memory_stats()
+                if not stats:
+                    continue
+                have = True
+                used += int(stats.get("bytes_in_use", 0) or 0)
+                hwm += int(
+                    stats.get("peak_bytes_in_use", 0)
+                    or stats.get("bytes_in_use", 0)
+                    or 0
+                )
+            if have:
+                reg.gauge_set("hbm/used_bytes", used)
+                reg.gauge_max("hbm/peak_bytes", hwm)
+    except Exception:
+        pass  # no backend yet / unsupported device: skip HBM gauges
+    try:
+        from raydp_tpu.store.object_store import get_current_store
+
+        store = get_current_store()
+        if store is not None:
+            occ = store.occupancy_bytes()
+            reg.gauge_set("store/occupancy_bytes", occ)
+            reg.gauge_max("store/occupancy_peak_bytes", occ)
+    except Exception:
+        pass

@@ -14,6 +14,10 @@ rm -f "$LOG"
 # Crash black box for CI: every test-spawned process dumps a postmortem
 # bundle here on crash/SIGTERM/watchdog stall; shipped on failure below.
 export RAYDP_TPU_POSTMORTEM_DIR="${RAYDP_TPU_POSTMORTEM_DIR:-/tmp/raydp_tpu_postmortem.$$}"
+# Query-profiling artifacts: every DataFrame stage the tests execute
+# appends its StageStats record here (stats-<pid>.jsonl shards),
+# dumped below on failure so CI shows what the engine was doing.
+export RAYDP_TPU_STATS_DIR="${RAYDP_TPU_STATS_DIR:-/tmp/raydp_tpu_stats.$$}"
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
   -m 'not slow' --continue-on-collection-errors \
   -p no:cacheprovider -p no:xdist -p no:randomly "$@" 2>&1 | tee "$LOG"
@@ -24,5 +28,40 @@ if [ "$rc" -ne 0 ]; then
   # flight events (no-op message when nothing crashed).
   echo "--- newest postmortem bundle (if any) ---"
   python -m raydp_tpu.telemetry.flight_recorder "$RAYDP_TPU_POSTMORTEM_DIR" || true
+  # Stage-stats tail + live progress: which stages ran last, and what
+  # (if anything) was still in flight when the suite died.
+  echo "--- last dataframe stage stats (if any) ---"
+  newest_shard=$(ls -t "$RAYDP_TPU_STATS_DIR"/stats-*.jsonl 2>/dev/null | head -1)
+  if [ -n "$newest_shard" ]; then
+    tail -5 "$newest_shard"
+  else
+    echo "(no stage-stat shards)"
+  fi
+  echo "--- progress report ---"
+  JAX_PLATFORMS=cpu python -c 'import json; from raydp_tpu.telemetry.progress import progress; print(json.dumps(progress.report()))' || true
+fi
+# EXPLAIN ANALYZE smoke: a window->groupBy pipeline must profile end to
+# end and the analyze CLI must fold its stats shards into the report.
+if [ "$rc" -eq 0 ]; then
+  echo "--- explain-analyze smoke ---"
+  smoke_dir=$(mktemp -d)
+  JAX_PLATFORMS=cpu RAYDP_TPU_STATS_DIR="$smoke_dir" python - <<'PYEOF' \
+    && JAX_PLATFORMS=cpu python -m raydp_tpu.telemetry.analyze "$smoke_dir" >/dev/null \
+    && echo "ANALYZE_SMOKE=ok" || { echo "ANALYZE_SMOKE=failed"; rc=1; }
+import numpy as np, pandas as pd
+import raydp_tpu.dataframe as rdf
+from raydp_tpu.dataframe import dataframe as D
+D._EXCHANGE_COALESCE_BYTES = 0
+df = rdf.from_pandas(
+    pd.DataFrame({"k": np.arange(4000) % 13, "v": np.arange(4000.0)}),
+    num_partitions=4,
+)
+out = df.withColumn(
+    "rn", rdf.row_number().over(rdf.Window.partitionBy("k").orderBy("v"))
+).groupBy("k").agg({"v": "max"})
+text = out.explain(analyze=True, quiet=True)
+assert "== Physical Plan ==" in text and "skew" in text, text
+PYEOF
+  rm -rf "$smoke_dir"
 fi
 exit $rc
