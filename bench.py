@@ -1581,6 +1581,150 @@ def bench_etl_shuffle():
     return out
 
 
+# ----------------------------------------------------------- device plane
+
+def bench_device_plane():
+    """Device-performance-plane evidence: (a) the phase fractions the
+    step accounting reports on a synthetic stream fit (they must sum to
+    ~1.0), and (b) the plane's overhead against the same fit with
+    ``RAYDP_TPU_DEVICE_PLANE=0`` — interleaved runs + medians, same
+    discipline as ``stage_stats_overhead``; budget <5%."""
+    import pandas as pd
+
+    from raydp_tpu.models.mlp import MLP
+    from raydp_tpu.train.estimator import JAXEstimator
+
+    n_rows, n_feat, batch = 16_384, 14, 256
+    rs = np.random.RandomState(11)
+    x = rs.rand(n_rows, n_feat).astype(np.float32)
+    w = rs.rand(n_feat, 1).astype(np.float32)
+    y = (x @ w).astype(np.float32)
+    cols = [f"f{i}" for i in range(n_feat)]
+    df = pd.DataFrame(x, columns=cols)
+    df["label"] = y
+
+    def one_fit():
+        est = JAXEstimator(
+            model=MLP(hidden=(64, 32), out_dim=1),
+            loss="mse",
+            num_epochs=1,
+            batch_size=batch,
+            feature_columns=cols,
+            label_column="label",
+            epoch_mode="stream",
+        )
+        t0 = time.perf_counter()
+        history = est.fit_on_df(df)
+        return time.perf_counter() - t0, history
+
+    one_fit()  # warm the jit caches both arms share
+    ons, offs = [], []
+    phases = None
+    try:
+        for i in range(10):
+            if i % 2 == 0:
+                dt, history = one_fit()
+                ons.append(dt)
+                phases = history[-1].get("phases") or phases
+            else:
+                os.environ["RAYDP_TPU_DEVICE_PLANE"] = "0"
+                offs.append(one_fit()[0])
+                os.environ.pop("RAYDP_TPU_DEVICE_PLANE", None)
+    finally:
+        os.environ.pop("RAYDP_TPU_DEVICE_PLANE", None)
+    ons.sort(), offs.sort()
+    on_s, off_s = ons[len(ons) // 2], offs[len(offs) // 2]
+    out = {
+        "samples_per_sec": round(n_rows / on_s, 1),
+        "unit": "samples/s",
+        "enabled_s": round(on_s, 4),
+        "disabled_s": round(off_s, 4),
+        "overhead_frac": round(
+            (on_s - off_s) / off_s if off_s else 0.0, 4
+        ),
+        "baseline": "same fit with RAYDP_TPU_DEVICE_PLANE=0",
+    }
+    if phases:
+        out["phases"] = phases
+        out["frac_sum"] = round(sum(
+            phases.get(k, 0.0)
+            for k in ("input_wait_frac", "dispatch_frac",
+                      "compute_frac", "collective_frac")
+        ), 4)
+    return out
+
+
+def _capture_gang_profile() -> dict:
+    """``--profile``: spin a 2-rank SPMD gang running a small stream
+    fit and gang-capture a trace mid-training; the merged Perfetto path
+    + the fit's phase fractions stamp into the result JSON. CPU-pinned
+    (the evidence is the machinery, not chip speed)."""
+    import threading as _threading
+
+    from raydp_tpu.spmd.job import SPMDJob
+
+    out_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_profile"
+    )
+
+    def rank_fit(ctx):
+        import numpy as np
+        import pandas as pd
+
+        from raydp_tpu.models.mlp import MLP
+        from raydp_tpu.train.estimator import JAXEstimator
+
+        rs = np.random.RandomState(ctx.rank)
+        n_feat = 8
+        x = rs.rand(8_192, n_feat).astype(np.float32)
+        df = pd.DataFrame(x, columns=[f"f{i}" for i in range(n_feat)])
+        df["label"] = x.sum(axis=1).astype(np.float32)
+        est = JAXEstimator(
+            model=MLP(hidden=(32,), out_dim=1),
+            loss="mse",
+            num_epochs=4,
+            batch_size=256,
+            feature_columns=[f"f{i}" for i in range(n_feat)],
+            label_column="label",
+            epoch_mode="stream",
+        )
+        history = est.fit_on_df(df)
+        return history[-1].get("phases")
+
+    job = SPMDJob(
+        "bench-profile", world_size=2,
+        env={"JAX_PLATFORMS": "cpu"}, timeout=120.0,
+    )
+    job.start()
+    try:
+        results: dict = {}
+
+        def _run():
+            try:
+                results["phases"] = job.run(rank_fit, timeout=300.0)
+            except Exception as exc:
+                results["error"] = f"{type(exc).__name__}: {exc}"
+
+        t = _threading.Thread(target=_run, daemon=True)
+        t.start()
+        time.sleep(3.0)  # let both ranks reach steady-state training
+        merged = job.capture_profile(seconds=3.0, out_dir=out_dir)
+        t.join(timeout=300.0)
+        profile = {
+            "merged_trace": merged.get("merged_trace"),
+            "ranks": merged.get("ranks"),
+        }
+        if results.get("phases"):
+            profile["phases"] = results["phases"]
+        if results.get("error"):
+            profile["fit_error"] = results["error"]
+        if merged.get("errors"):
+            profile["capture_errors"] = merged["errors"]
+        return profile
+    finally:
+        job.stop()
+
+
 # ----------------------------------------------------------- main
 
 # The CPU matrix runs in THIS process (pinned to the CPU platform —
@@ -1597,6 +1741,8 @@ CPU_MATRIX = [
     # Host-side like the ETL configs: cluster + loader mechanics, no
     # device math — full size even in CPU-fallback mode.
     ("dataplane", bench_dataplane),
+    # Phase-accounting overhead + fraction evidence (host-side fit).
+    ("device_plane", bench_device_plane),
     # Ingest is bandwidth-sensitive: keep it ahead of the model configs
     # that leave host-memory pressure behind.
     ("ingest_device_feed", bench_ingest),
@@ -1634,6 +1780,7 @@ _STATE = {
     "cpu": {},        # name -> result (small-size CPU-fallback run)
     "chip": {},       # name -> result (full-size on-accelerator run)
     "chip_device": None,
+    "profile": None,  # --profile: merged gang trace path + phases
     "notes": [],
     "emitted": False,
 }
@@ -1687,6 +1834,8 @@ def _assemble() -> dict:
         out["chip_device"] = _STATE["chip_device"]
     if _STATE["chip"]:
         out["chip_matrix"] = _STATE["chip"]
+    if _STATE["profile"]:
+        out["profile"] = _STATE["profile"]
     if _STATE["notes"]:
         out["note"] = "; ".join(_STATE["notes"])
     return out
@@ -1982,6 +2131,9 @@ def main(argv=None):
         budget = float(argv[argv.index("--budget") + 1])
         return _chip_worker(sidecar, budget)
     trace_out = _parse_trace_out(argv)
+    want_profile = "--profile" in argv
+    if want_profile:
+        argv.remove("--profile")
 
     signal.signal(signal.SIGTERM, _on_signal)
     signal.signal(signal.SIGINT, _on_signal)
@@ -2064,6 +2216,13 @@ def main(argv=None):
                 "accelerator client unreachable (pool handshake "
                 f"timeout after {probe.attempts} probe attempts); "
                 "model configs ran on CPU at fallback sizes"
+            )
+    if want_profile:
+        try:
+            _STATE["profile"] = _capture_gang_profile()
+        except Exception as exc:  # profile must never sink the bench
+            _STATE["notes"].append(
+                f"gang profile failed: {type(exc).__name__}: {exc}"
             )
     if trace_out is not None:
         _write_trace_out(trace_out)

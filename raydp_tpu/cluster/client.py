@@ -231,6 +231,54 @@ class RemoteCluster:
             pass  # older master without the ProgressReport handler
         return report
 
+    def capture_profile(
+        self, seconds: float = 3.0, out_dir: Optional[str] = None
+    ) -> Optional[dict]:
+        """Client-mode twin of ``Cluster.capture_profile``: fan
+        ProfileRequest out to every alive worker directly (the client
+        already holds worker stubs for task submission) and merge the
+        archives here. The client process itself is not captured — it
+        runs no device work. Worker archives staged in the shm store
+        are resolved through the normal data plane."""
+        from raydp_tpu.telemetry import device_profiler
+
+        workers = self.alive_workers()
+        if not workers:
+            return None
+        payloads: Dict[str, dict] = {}
+        errors: Dict[str, str] = {}
+
+        def _one(info: WorkerInfo) -> None:
+            try:
+                payloads[info.worker_id] = self._worker_client(info).call(
+                    "ProfileRequest", {"seconds": seconds},
+                    timeout=seconds + 30.0,
+                )
+            except Exception as exc:
+                errors[info.worker_id] = str(exc)
+
+        threads = [
+            threading.Thread(target=_one, args=(w,), daemon=True)
+            for w in workers
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=seconds + 60.0)
+        if not payloads:
+            raise ClientError(
+                f"profile capture failed on every worker: {errors}"
+            )
+        ordered = [payloads[wid] for wid in sorted(payloads)]
+        for payload in ordered:
+            ref = payload.pop("ref", None)
+            if ref is not None and "zip" not in payload:
+                payload["zip"] = self.resolver.get_bytes(ref)
+        merged = device_profiler.merge_rank_traces(ordered, out_dir)
+        if errors:
+            merged["errors"] = errors
+        return merged
+
     # -- task submission ------------------------------------------------
     def submit(self, fn, *args, worker_id=None, timeout=300.0, **kwargs):
         return self.submit_async(

@@ -175,6 +175,9 @@ class Cluster:
             self._metrics_server = serve_prometheus(
                 self.prometheus_metrics, int(port),
                 progress=self.progress_report,
+                # /debug/profile?seconds=N → cluster-wide gang capture,
+                # not just the driver process.
+                profile=lambda seconds: self.capture_profile(seconds) or {},
             )
             logger.info(
                 "prometheus scrape endpoint on :%d/metrics",
@@ -599,6 +602,65 @@ class Cluster:
         report = progress.report()
         report["stage_totals"] = stage_store.snapshot()["totals"]
         return report
+
+    def capture_profile(
+        self, seconds: float = 3.0, out_dir: Optional[str] = None
+    ) -> Optional[dict]:
+        """Cluster-wide coordinated trace capture: every alive worker —
+        and the driver itself — records a ``jax.profiler`` trace for
+        ``seconds`` starting at (nearly) the same wall instant; the
+        per-process archives are merged into one clock-aligned Perfetto
+        file (``merged_trace.json`` under the returned ``out_dir``).
+
+        Worker archives travel through the shm object store (a ref on
+        the reply, resolved driver-side), so the trace zips ride the
+        data plane, not the control RPC. Also exposed as
+        ``/debug/profile?seconds=N`` on the driver metrics endpoint.
+        None before :meth:`start`."""
+        if self.master is None:
+            return None
+        from raydp_tpu.telemetry import device_profiler
+
+        workers = self.alive_workers()
+        payloads: Dict[str, dict] = {}
+        errors: Dict[str, str] = {}
+
+        def _one(worker_id: str) -> None:
+            client = self._client_for(worker_id)
+            if client is None:
+                errors[worker_id] = "no client"
+                return
+            try:
+                payloads[worker_id] = client.call(
+                    "ProfileRequest", {"seconds": seconds},
+                    timeout=seconds + 30.0,
+                )
+            except Exception as exc:
+                errors[worker_id] = str(exc)
+
+        threads = [
+            threading.Thread(target=_one, args=(w.worker_id,), daemon=True)
+            for w in workers
+        ]
+        for t in threads:
+            t.start()
+        # The driver participates too, concurrent with the fan-out: its
+        # infeed/dispatch threads are half the step-phase story.
+        driver_payload = device_profiler.capture_trace_archive(seconds)
+        driver_payload["worker_id"] = "driver"
+        for t in threads:
+            t.join(timeout=seconds + 60.0)
+        ordered = [driver_payload] + [
+            payloads[wid] for wid in sorted(payloads)
+        ]
+        for payload in ordered:  # store-shipped archives → bytes
+            ref = payload.pop("ref", None)
+            if ref is not None and "zip" not in payload:
+                payload["zip"] = self.resolver.get_bytes(ref)
+        merged = device_profiler.merge_rank_traces(ordered, out_dir)
+        if errors:
+            merged["errors"] = errors
+        return merged
 
     # -- task submission --------------------------------------------------
     def submit(

@@ -128,6 +128,7 @@ class Worker:
                 "RunTaskBatch": self._on_run_task_batch,
                 "Ping": lambda req: {"pong": True, "worker_id": self.worker_id},
                 "Stop": self._on_stop,
+                "ProfileRequest": self._on_profile,
             },
             host=bind_host,
         )
@@ -291,6 +292,28 @@ class Worker:
         finally:
             with self._busy_lock:
                 self._busy -= 1
+
+    def _on_profile(self, req: dict) -> dict:
+        """Gang trace capture on this ETL worker. Runs on the RPC
+        handler thread, concurrent with any in-flight tasks — the trace
+        samples them live. The zip ships through the shm object store
+        when the worker is registered (``{"ref": ...}``); inline bytes
+        are the pre-registration fallback."""
+        from raydp_tpu.telemetry import device_profiler
+
+        seconds = float(req.get("seconds", 3.0))
+        _flight.record("profile", "start", worker_id=self.worker_id,
+                       seconds=seconds)
+        payload = device_profiler.capture_trace_archive(seconds)
+        payload["worker_id"] = self.worker_id
+        if self._ready.is_set():
+            try:
+                blob = payload.pop("zip")
+                payload["ref"] = self.ctx.put_bytes(blob)
+            except Exception:
+                payload["zip"] = blob  # store unavailable: inline
+        _flight.record("profile", "end", worker_id=self.worker_id)
+        return payload
 
     def _on_stop(self, req: dict) -> dict:
         # Register the objects this worker still owns with the master before

@@ -26,6 +26,7 @@ from __future__ import annotations
 import os
 import queue
 import threading
+import time
 from collections import deque
 from typing import Dict, Iterator, List, NamedTuple, Optional, Tuple
 
@@ -411,8 +412,16 @@ def _background(it: Iterator, depth: int):
     thread.start()
 
     def consume():
+        # Consumer-side starvation is the input-wait half of the step
+        # phase model: every second spent blocked here is a second the
+        # training loop sat idle waiting for data. The producer already
+        # accounts its own pack/put time; this counter closes the gap.
         while True:
+            t0 = time.perf_counter()
             item = q.get()
+            metrics.counter_add(
+                "ingest/wait_seconds", time.perf_counter() - t0
+            )
             if item is _DONE:
                 return
             if isinstance(item, BaseException):

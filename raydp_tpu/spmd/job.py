@@ -433,11 +433,62 @@ class SPMDJob:
         """Merged per-rank metrics view (heartbeat-shipped deltas)."""
         return self.telemetry.merged()
 
+    def capture_profile(
+        self, seconds: float = 3.0, out_dir: Optional[str] = None
+    ) -> dict:
+        """Gang-coordinated trace capture: every rank starts a
+        ``jax.profiler`` trace at (nearly) the same wall instant, records
+        for ``seconds``, and ships the trace directory back as a zip;
+        the driver merges them into one clock-aligned Perfetto file
+        (``merged_trace.json`` under ``out_dir``).
+
+        The fan-out uses one thread per rank so the start skew is RPC
+        latency, not ``world_size × seconds``. Capture runs on each
+        rank's RPC handler thread — concurrent with the shipped function
+        on the runner thread, so it samples live training."""
+        if not self._started:
+            raise SPMDJobError("job not started")
+        from raydp_tpu.telemetry import device_profiler
+
+        payloads: Dict[int, dict] = {}
+        errors: Dict[int, str] = {}
+
+        def _one(rank: int, stub: RpcClient) -> None:
+            try:
+                payloads[rank] = stub.call(
+                    "ProfileRequest", {"seconds": seconds},
+                    timeout=seconds + 30.0,
+                )
+            except Exception as exc:  # partial gang still merges
+                errors[rank] = str(exc)
+
+        threads = [
+            threading.Thread(target=_one, args=(rank, stub), daemon=True)
+            for rank, stub in sorted(self._stubs.items())
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=seconds + 60.0)
+        if not payloads:
+            raise SPMDJobError(
+                f"profile capture failed on every rank: {errors}"
+            )
+        ordered = [payloads[r] for r in sorted(payloads)]
+        merged = device_profiler.merge_rank_traces(ordered, out_dir)
+        if errors:
+            merged["errors"] = errors
+        _flight.record("profile", "merged", job=self.job_name,
+                       ranks=len(ordered))
+        return merged
+
     def resource_report(self) -> dict:
         """Per-rank resource accounting from the shipped gauges: host
         RSS, device HBM used/peak, plus XLA compile counters — the
         training-side face of the query-profiling plane. Ranks that have
         not yet shipped gauges appear with empty dicts."""
+        from raydp_tpu.telemetry import device_profiler
+
         view = self.telemetry.merged()
         ranks = {}
         for rid, sections in sorted((view.get("workers") or {}).items()):
@@ -452,6 +503,23 @@ class SPMDJob:
                 "compile_seconds": counters.get("compile/seconds", 0.0),
                 "compile_failures": counters.get("compile/failures", 0),
             }
+            # Device performance plane, when the rank has shipped phase
+            # gauges (set at each epoch boundary by the estimator).
+            fractions = {
+                name: gauges[f"phase/{name}"]
+                for name in ("input_wait_frac", "dispatch_frac",
+                             "compute_frac", "collective_frac")
+                if f"phase/{name}" in gauges
+            }
+            if fractions:
+                ranks[rid]["phases"] = fractions
+                ranks[rid]["bound"] = device_profiler.classify_fractions(
+                    fractions,
+                    gauges.get("roofline/intensity_flops_per_byte"),
+                    gauges.get("roofline/machine_balance"),
+                )
+            if "mfu" in gauges:
+                ranks[rid]["mfu"] = gauges["mfu"]
         agg = view.get("aggregate") or {}
         agg_gauges = agg.get("gauges") or {}
         agg_counters = agg.get("counters") or {}

@@ -102,6 +102,7 @@ class SPMDWorker:
             {
                 "RunFunction": self._on_run_function,
                 "Stop": self._on_stop,
+                "ProfileRequest": self._on_profile,
             },
             host="0.0.0.0" if multihost else "127.0.0.1",
         )
@@ -118,6 +119,23 @@ class SPMDWorker:
         self._stop_event.set()
         self._queue.put(None)
         return {"stopping": True}
+
+    def _on_profile(self, req: dict) -> dict:
+        """Gang-coordinated trace capture: runs ON the RPC handler
+        thread, concurrent with whatever shipped function the runner
+        thread is executing — that concurrency is the point: the trace
+        window samples live training, it does not pause it."""
+        from raydp_tpu.telemetry import device_profiler
+
+        seconds = float(req.get("seconds", 3.0))
+        _flight.record("profile", "start", rank=self.rank,
+                       seconds=seconds)
+        payload = device_profiler.capture_trace_archive(
+            seconds, rank=self.rank
+        )
+        _flight.record("profile", "end", rank=self.rank,
+                       nbytes=len(payload.get("zip") or b""))
+        return payload
 
     def _runner(self) -> None:
         while not self._stop_event.is_set():

@@ -35,6 +35,13 @@ without pulling in jax):
   postmortem bundles (event tail + all-thread stacks), and
   trace-stamped JSONL structured logs.
 
+* :mod:`~raydp_tpu.telemetry.device_profiler` — the device performance
+  plane: per-step phase breakdown (input-wait / dispatch / compute /
+  collective), live MFU + roofline bound-ness from HLO cost analysis,
+  gang-coordinated ``jax.profiler`` capture merged into one Perfetto
+  trace (``Cluster.capture_profile()`` / ``/debug/profile``), and
+  NaN / step-regression anomaly sentinels.
+
 Drivers pull the live aggregate with ``Cluster.metrics_snapshot()``
 (works identically through ``raydp_tpu.connect`` client sessions).
 See ``doc/telemetry.md``.
@@ -54,7 +61,20 @@ from raydp_tpu.telemetry.export import (
     telemetry_dir,
     write_events,
 )
-from raydp_tpu.telemetry import flight_recorder, logs, progress, watchdog
+from raydp_tpu.telemetry import (
+    device_profiler,
+    flight_recorder,
+    logs,
+    progress,
+    watchdog,
+)
+from raydp_tpu.telemetry.device_profiler import (
+    AnomalySentinel,
+    StepPhaseAccumulator,
+    capture_trace_archive,
+    classify_fractions,
+    merge_rank_traces,
+)
 from raydp_tpu.telemetry.progress import (
     PROGRESS_LOG_ENV,
     STAGE_STATS_ENV,
@@ -105,6 +125,12 @@ __all__ = [
     "flight_recorder",
     "logs",
     "watchdog",
+    "device_profiler",
+    "AnomalySentinel",
+    "StepPhaseAccumulator",
+    "capture_trace_archive",
+    "classify_fractions",
+    "merge_rank_traces",
     "Watchdog",
     "inflight",
     "dump_bundle",

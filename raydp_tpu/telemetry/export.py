@@ -275,6 +275,27 @@ def render_prometheus(view: Dict[str, Any]) -> str:
         "MetricsRegistry gauges without a dedicated family, one series "
         "per (worker, name).",
     )
+    mfu = _Family(
+        "raydp_mfu", "gauge",
+        "Model FLOPs utilization: analytical step FLOPs (HLO cost "
+        "analysis) over measured step wall x device peak. Absent on "
+        "backends without a known peak (CPU).",
+    )
+    anomalies = _Family(
+        "raydp_anomalies_total", "counter",
+        "Training anomaly sentinel trips (kind=nan_loss|nan_grad_norm|"
+        "step_regression). NaN kinds also dump a flight-recorder bundle.",
+    )
+    step_hist = _Family(
+        "raydp_step_seconds", "histogram",
+        "Training step wall time (jitted-call dispatch; donated-buffer "
+        "block makes steady-state dispatch = device step time).",
+    )
+    generic_hist = _Family(
+        "raydp_histogram", "histogram",
+        "MetricsRegistry histograms without a dedicated family, one "
+        "series set per (worker, name).",
+    )
 
     sources: Dict[str, Dict[str, Any]] = dict(view.get("workers") or {})
     driver = view.get("driver")
@@ -350,6 +371,13 @@ def render_prometheus(view: Dict[str, Any]) -> str:
                                 section[name],
                             )
                             continue
+                    if name.startswith("anomalies/"):
+                        anomalies.add(
+                            {"worker": worker_id,
+                             "kind": name[len("anomalies/"):]},
+                            section[name],
+                        )
+                        continue
                     if name == "compile/count":
                         compiles.add({"worker": worker_id}, section[name])
                         continue
@@ -390,6 +418,8 @@ def render_prometheus(view: Dict[str, Any]) -> str:
                              else "current"},
                             value,
                         )
+                    elif name == "mfu":
+                        mfu.add({"worker": worker_id}, value)
                     else:
                         gauges.add(
                             {"worker": worker_id, "name": name}, value
@@ -407,13 +437,41 @@ def render_prometheus(view: Dict[str, Any]) -> str:
                     )
                 timers.add(labels, section.get("total_s", 0.0), suffix="_sum")
                 timers.add(labels, section.get("count", 0.0), suffix="_count")
+            elif key.startswith("hist/"):
+                name = key[len("hist/"):]
+                if name == "train/step_seconds":
+                    family, labels = step_hist, {"worker": worker_id}
+                else:
+                    family = generic_hist
+                    labels = {"worker": worker_id, "name": name}
+                buckets = section.get("buckets") or {}
+                # Registry summaries store cumulative counts keyed by
+                # upper bound; exposition order must be ascending with
+                # +Inf last (Prometheus requires the _bucket ramp).
+                finite = sorted(
+                    (b for b in buckets if b != "+Inf"), key=float
+                )
+                for bound in finite:
+                    family.add(
+                        {**labels, "le": bound}, buckets[bound],
+                        suffix="_bucket",
+                    )
+                family.add(
+                    {**labels, "le": "+Inf"},
+                    buckets.get("+Inf", section.get("count", 0.0)),
+                    suffix="_bucket",
+                )
+                family.add(labels, section.get("sum", 0.0), suffix="_sum")
+                family.add(labels, section.get("count", 0.0),
+                           suffix="_count")
 
     lines: List[str] = []
     for family in (up, counters, meter_total, meter_rate, timers, dropped,
                    stalls, rpc_payload, shuffle_bytes, shuffle_local,
                    shuffles_elided, stage_rows, stage_bytes, stage_seconds,
                    compiles, compile_seconds, compile_failures, host_rss,
-                   hbm_bytes, store_occupancy, gauges):
+                   hbm_bytes, store_occupancy, mfu, anomalies, step_hist,
+                   generic_hist, gauges):
         lines.extend(family.render())
     return "\n".join(lines) + ("\n" if lines else "")
 
@@ -472,12 +530,34 @@ def _default_progress() -> Dict[str, Any]:
     return report
 
 
+# /debug/profile capture windows: clamped so a fat-fingered
+# ?seconds=86400 can't pin a handler thread (and a jax trace buffer)
+# for a day.
+_PROFILE_MAX_SECONDS = 120.0
+
+
+def _default_profile(seconds: float) -> Dict[str, Any]:
+    """Single-process capture: a jax.profiler trace of THIS process for
+    ``seconds``, written under the telemetry dir (or a tempdir). Driver
+    endpoints override this with the gang-coordinated capture."""
+    from raydp_tpu.telemetry import device_profiler as _devprof
+
+    base = telemetry_dir()
+    out_dir = None
+    if base:
+        out_dir = os.path.join(
+            base, f"profile-{os.getpid()}-{int(time.time())}"
+        )
+    return _devprof.capture_local_trace(seconds, out_dir)
+
+
 def serve_prometheus(
     render: Callable[[], str],
     port: int,
     host: str = "0.0.0.0",
     health: Optional[Callable[[], Dict[str, Any]]] = None,
     progress: Optional[Callable[[], Dict[str, Any]]] = None,
+    profile: Optional[Callable[[float], Dict[str, Any]]] = None,
 ) -> _ScrapeServer:
     """Serve the process debug surface on a daemon thread.
 
@@ -489,10 +569,14 @@ def serve_prometheus(
     ``/healthz`` (JSON from ``health()`` — default: the local watchdog
     — with status 503 when unhealthy, the k8s *readiness* target),
     ``/debug/state`` (health + flight-recorder tail + metrics
-    snapshot), ``/debug/stacks`` (plain-text all-thread dump), and
+    snapshot), ``/debug/stacks`` (plain-text all-thread dump),
     ``/debug/progress`` (JSON from ``progress()`` — default: the
     process's live :mod:`~raydp_tpu.telemetry.progress` tracker plus
-    stage-store totals).
+    stage-store totals), and ``/debug/profile?seconds=N`` (on-demand
+    device trace: ``profile(seconds)`` — default a single-process
+    jax.profiler capture; the driver endpoint passes the
+    gang-coordinated ``Cluster.capture_profile``; blocks the request
+    for the capture window, other routes stay responsive).
     Stdlib ``http.server`` only: one scrape every few seconds, no need
     for more. ``port=0`` binds an ephemeral port. Returns a handle with
     ``.port`` and idempotent ``.close()``."""
@@ -500,6 +584,7 @@ def serve_prometheus(
 
     health_fn = health if health is not None else _default_health
     progress_fn = progress if progress is not None else _default_progress
+    profile_fn = profile if profile is not None else _default_profile
 
     class Handler(BaseHTTPRequestHandler):
         def _reply(self, code: int, body: bytes, ctype: str) -> None:
@@ -510,7 +595,10 @@ def serve_prometheus(
             self.wfile.write(body)
 
         def do_GET(self):  # noqa: N802 - http.server API
-            path = self.path.split("?")[0]
+            from urllib.parse import parse_qs, urlsplit
+
+            parts = urlsplit(self.path)
+            path, query = parts.path, parse_qs(parts.query)
             try:
                 if path in ("/metrics", "/"):
                     self._reply(
@@ -552,6 +640,22 @@ def serve_prometheus(
                         ).encode("utf-8"),
                         "application/json",
                     )
+                elif path == "/debug/profile":
+                    try:
+                        seconds = float(query.get("seconds", ["3"])[0])
+                    except ValueError:
+                        self.send_error(400, "seconds must be a number")
+                        return
+                    seconds = min(
+                        max(0.0, seconds), _PROFILE_MAX_SECONDS
+                    )
+                    self._reply(
+                        200,
+                        json.dumps(
+                            profile_fn(seconds), default=str
+                        ).encode("utf-8"),
+                        "application/json",
+                    )
                 elif path == "/debug/stacks":
                     from raydp_tpu.telemetry import flight_recorder as _fl
 
@@ -584,7 +688,7 @@ def serve_prometheus(
     logger.info(
         "telemetry debug endpoint on %s:%d "
         "(/metrics /livez /healthz /debug/state /debug/stacks "
-        "/debug/progress)",
+        "/debug/progress /debug/profile)",
         host, server.port,
     )
     return server

@@ -157,6 +157,20 @@ class ClusterTelemetry:
                     agg = aggregate.setdefault(key, {})
                     for stat, value in section.items():
                         agg[stat] = agg.get(stat, 0.0) + value
+                elif key.startswith("hist/"):
+                    # Histogram buckets are cumulative counts — exact
+                    # cross-worker merge is plain summation, bucket by
+                    # bucket (same bounds on every worker by
+                    # construction: one Histogram class).
+                    agg = aggregate.setdefault(
+                        key, {"sum": 0.0, "count": 0.0, "buckets": {}}
+                    )
+                    agg["sum"] += float(section.get("sum", 0.0))
+                    agg["count"] += float(section.get("count", 0.0))
+                    for bound, n in (section.get("buckets") or {}).items():
+                        agg["buckets"][bound] = (
+                            agg["buckets"].get(bound, 0.0) + float(n)
+                        )
         for key, section in aggregate.items():
             if key.startswith("timer/"):
                 section["mean_s"] = section.get("total_s", 0.0) / max(

@@ -26,7 +26,9 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from raydp_tpu.data.ml_dataset import MLDataset
 from raydp_tpu.parallel.mesh import MeshSpec
+from raydp_tpu.telemetry import event as _event
 from raydp_tpu.telemetry import flush_spans, span
+from raydp_tpu.telemetry import device_profiler as _devplane
 from raydp_tpu.telemetry import flight_recorder as _flight
 from raydp_tpu.telemetry import watchdog as _watchdog
 from raydp_tpu.train.losses import resolve_loss, resolve_metric
@@ -59,6 +61,14 @@ def _guard_compile(jitted: Callable, label: str) -> Callable:
                 exc, time.monotonic() - start, label
             ) from exc
         state["first"] = False
+        # First dispatch is also the cost-analysis moment: register
+        # analytical FLOPs/bytes for the MFU/roofline gauges. lower()
+        # only re-traces (the jit cache keeps the compiled executable),
+        # and a backend without cost analysis is a silent no-op.
+        try:
+            _devplane.note_compiled(label, jitted, args, kwargs)
+        except Exception:
+            pass
         return out
 
     return wrapped
@@ -251,6 +261,9 @@ class JAXEstimator:
         self._train_step = None
         self._eval_step = None
         self._predict_step = None
+        # Device-plane state: live only while a stream fit runs.
+        self._phases = None
+        self._sentinel = None
         self.history: List[Dict[str, float]] = []
 
     # -- mesh / state setup ---------------------------------------------
@@ -355,7 +368,11 @@ class JAXEstimator:
                 return loss_fn(preds, target)
 
             loss_val, grads = jax.value_and_grad(compute)(state.params)
-            return state.apply_gradients(grads=grads), loss_val
+            # Global grad-norm rides along for the anomaly sentinel: an
+            # Inf/NaN here flags divergence one step before the loss
+            # shows it, and computing it on device costs one reduction.
+            gnorm = optax.global_norm(grads)
+            return state.apply_gradients(grads=grads), loss_val, gnorm
 
         return train_step
 
@@ -428,10 +445,27 @@ class JAXEstimator:
         if depth is None:
             depth = self.infeed_depth
         window: deque = deque()
-        for x, y in host_iter:
+        # Phase accounting (when a fit is live): time blocked pulling
+        # the next host batch is the step's input-wait; shard +
+        # device_put time is host dispatch. Both accrue against the
+        # step that consumes them.
+        phases = self._phases
+        it = iter(host_iter)
+        while True:
+            t0 = time.perf_counter()
+            try:
+                x, y = next(it)
+            except StopIteration:
+                break
+            if phases is not None:
+                phases.note_input_wait(time.perf_counter() - t0)
             if self._state is None:
                 self._init_state(x)
-            window.append(self._shard_batch(x, y) + (len(x),))
+            t1 = time.perf_counter()
+            item = self._shard_batch(x, y) + (len(x),)
+            if phases is not None:
+                phases.note_dispatch(time.perf_counter() - t1)
+            window.append(item)
             if len(window) > depth:
                 yield window.popleft()
         while window:
@@ -495,6 +529,20 @@ class JAXEstimator:
             "samples": n_samples,
             "samples_per_sec": n_samples / max(1e-9, dt),
         }
+        if self._phases is not None and self._phases.epoch_steps:
+            # Phase breakdown + bound-ness for THIS epoch; the summary
+            # also refreshes the live gauges (phase/*_frac, mfu) and is
+            # dropped into the span shards as a train/phases event so
+            # analyze.py sees it per process/rank.
+            phase_summary = self._phases.epoch_summary()
+            metrics["phases"] = phase_summary
+            metrics["bound"] = phase_summary["bound"]
+            if "mfu" in phase_summary:
+                metrics["mfu"] = phase_summary["mfu"]
+            _event("train/phases", epoch=epoch, **{
+                k: v for k, v in phase_summary.items()
+                if isinstance(v, (int, float, str))
+            })
         if evaluate_ds is not None:
             metrics.update(self.evaluate(evaluate_ds, prefix="eval_"))
         self.history.append(metrics)
@@ -592,6 +640,14 @@ class JAXEstimator:
                 rng, _ = jax.random.split(rng)
         steps_done = int(self._state.step) if self._state is not None else 0
         failures = 0
+        # Device performance plane: phase accumulator feeds _finish_epoch
+        # (and the phase/* gauges); the sentinel checks loss/grad-norm
+        # finiteness on a sampled cadence and watches for step-time
+        # regressions. RAYDP_TPU_DEVICE_PLANE=0 turns both off.
+        if _devplane.enabled():
+            self._phases = _devplane.StepPhaseAccumulator("train_step")
+            self._sentinel = _devplane.AnomalySentinel()
+        sentinel = self._sentinel
         for epoch in range(start_epoch, epochs):
             t0 = time.perf_counter()
             for loader in loaders:
@@ -638,7 +694,9 @@ class JAXEstimator:
                     ), span("train/step", epoch=epoch, step=b_idx) as sp:
                         while True:
                             try:
-                                self._state, loss_val = self._train_step(
+                                (
+                                    self._state, loss_val, grad_norm,
+                                ) = self._train_step(
                                     self._state, xd, yd, step_rng
                                 )
                                 break
@@ -666,6 +724,21 @@ class JAXEstimator:
                                     exc_info=True,
                                 )
                     step_timer.observe(sp.duration_s)
+                    if self._phases is not None:
+                        self._phases.step(sp.duration_s)
+                    if sentinel is not None:
+                        sentinel.observe_step(
+                            sp.duration_s, b_idx, epoch=epoch
+                        )
+                        if sentinel.wants_check(steps_done + 1):
+                            # Sampled sync point (the ONLY per-loop
+                            # float() besides the epoch boundary).
+                            sentinel.check_loss(
+                                float(loss_val), b_idx, epoch=epoch
+                            )
+                            sentinel.check_grad_norm(
+                                float(grad_norm), b_idx, epoch=epoch
+                            )
                     loss_sum = (
                         loss_val if loss_sum is None else loss_sum + loss_val
                     )
@@ -691,7 +764,12 @@ class JAXEstimator:
             train_loss = float(loss_sum) / max(1, n_batches) if (
                 loss_sum is not None
             ) else 0.0
+            if sentinel is not None:
+                # Epoch boundary always checks (the sampled cadence may
+                # never have landed on a NaN step in a short epoch).
+                sentinel.check_loss(train_loss, b_idx, epoch=epoch)
             self._finish_epoch(epoch, t0, train_loss, n_samples, evaluate_ds)
+        self._phases = None  # stop attributing eval/predict infeed
         for cb in self.callbacks:
             cb.on_train_end(self.history)
         return self.history
@@ -794,16 +872,19 @@ class JAXEstimator:
                     xs, step = inp
                     ys = None
                 step_key = jax.random.fold_in(key, step)
-                state, loss_val = train_step(state, xs, ys, step_key)
-                return state, loss_val
+                state, loss_val, gnorm = train_step(state, xs, ys, step_key)
+                return state, (loss_val, gnorm)
 
             xs_in = (
                 (xb, yb, jnp.arange(n_steps))
                 if yb is not None
                 else (xb, jnp.arange(n_steps))
             )
-            state, losses = jax.lax.scan(body, state, xs_in)
-            return state, losses.mean()
+            state, (losses, gnorms) = jax.lax.scan(body, state, xs_in)
+            # max over the fused steps: one non-finite step anywhere in
+            # the epoch must surface (a mean could mask a single Inf as
+            # NaN but a single huge-but-finite spike would vanish).
+            return state, losses.mean(), gnorms.max()
 
         # Honor donate_state here too: with donation off a callback may
         # safely hold a reference to the previous epoch's state.
@@ -848,6 +929,13 @@ class JAXEstimator:
         epoch_fn = self._build_epoch_fn(n_steps, batch)
         rng = self._prng_key(self.seed + 1)
         failures = 0
+        # Scan mode has no per-step host loop, so phase accounting does
+        # not apply; the sentinels still check each epoch's synced loss
+        # and worst grad-norm.
+        sentinel = (
+            _devplane.AnomalySentinel() if _devplane.enabled() else None
+        )
+        self._sentinel = sentinel
         for epoch in range(epochs):
             t0 = time.perf_counter()
             rng, key = jax.random.split(rng)
@@ -863,7 +951,7 @@ class JAXEstimator:
                       n_steps=n_steps):
                 while True:
                     try:
-                        self._state, mean_loss = epoch_fn(
+                        self._state, mean_loss, max_gnorm = epoch_fn(
                             self._state, xd, yd, key
                         )
                         break
@@ -884,6 +972,11 @@ class JAXEstimator:
                             exc_info=True,
                         )
                 train_loss = float(mean_loss)  # one sync per epoch
+                if sentinel is not None:
+                    sentinel.check_loss(train_loss, n_steps, epoch=epoch)
+                    sentinel.check_grad_norm(
+                        float(max_gnorm), n_steps, epoch=epoch
+                    )
             # True-sample throughput: padded duplicate rows don't count.
             metrics = self._finish_epoch(
                 epoch, t0, train_loss, n_true, evaluate_ds
@@ -962,14 +1055,20 @@ class JAXEstimator:
                 yield from loader
 
         # Same double-buffered sharded infeed as fit(): batch N+1's H2D
-        # overlaps batch N's eval step.
-        for xd, yd, blen in self._sharded_prefetch(host_batches()):
-            w = float(blen)
-            out = self._eval_step(self._state, xd, yd)
-            for k, v in out.items():
-                vw = v * w
-                totals[k] = vw if k not in totals else totals[k] + vw
-            weight_total += w
+        # overlaps batch N's eval step. Eval infeed must NOT accrue into
+        # the train-step phase accumulator (per-epoch eval would inflate
+        # the next epoch's input-wait), so it is parked for the loop.
+        phases, self._phases = self._phases, None
+        try:
+            for xd, yd, blen in self._sharded_prefetch(host_batches()):
+                w = float(blen)
+                out = self._eval_step(self._state, xd, yd)
+                for k, v in out.items():
+                    vw = v * w
+                    totals[k] = vw if k not in totals else totals[k] + vw
+                weight_total += w
+        finally:
+            self._phases = phases
         return {
             f"{prefix}{k}": float(v) / max(1e-9, weight_total)
             for k, v in totals.items()

@@ -28,12 +28,14 @@ __all__ = [
     "MetricsRegistry",
     "StepTimer",
     "ThroughputMeter",
+    "Histogram",
     "metrics",
     "trace",
     "annotate",
     "install_compile_listener",
     "enrich_compile_error",
     "sample_resource_gauges",
+    "cost_analysis_summary",
 ]
 
 
@@ -135,6 +137,56 @@ class ThroughputMeter:
             return {"total": self._units, "per_sec": self._rate_locked()}
 
 
+class Histogram:
+    """Fixed-bucket distribution, Prometheus-histogram shaped.
+
+    Unlike :class:`StepTimer` (rolling window, percentiles over recent
+    observations) a histogram is cumulative over the process lifetime,
+    so cross-worker merging is exact (bucket counts sum) and scrape-side
+    rate()/histogram_quantile() work. Buckets are upper bounds; counts
+    are stored per-bucket and emitted cumulatively by :meth:`summary`.
+    """
+
+    # Step times span ~100µs (tiny CPU models) to minutes (first-step
+    # compile); log-spaced bounds keep quantile error ≤ one bucket.
+    DEFAULT_BUCKETS = (
+        0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+        0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+    )
+
+    def __init__(self, buckets: Optional[List[float]] = None):
+        bounds = tuple(sorted(buckets)) if buckets else self.DEFAULT_BUCKETS
+        self.bounds = bounds
+        self._counts = [0] * (len(bounds) + 1)  # +1: the +Inf bucket
+        self._sum = 0.0
+        self._count = 0
+        self._mu = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        import bisect
+
+        idx = bisect.bisect_left(self.bounds, value)
+        with self._mu:
+            self._counts[idx] += 1
+            self._sum += value
+            self._count += 1
+
+    def summary(self) -> Dict[str, object]:
+        """``{"sum", "count", "buckets": {"<le>": cumulative, ...,
+        "+Inf": count}}`` — cumulative counts so the section merges
+        across workers by plain stat-wise summation."""
+        with self._mu:
+            counts = list(self._counts)
+            total, n = self._sum, self._count
+        buckets: Dict[str, float] = {}
+        running = 0
+        for bound, c in zip(self.bounds, counts):
+            running += c
+            buckets[repr(bound)] = float(running)
+        buckets["+Inf"] = float(n)
+        return {"sum": total, "count": float(n), "buckets": buckets}
+
+
 @dataclass
 class MetricsRegistry:
     """Named counters/timers/meters; one process-wide instance at
@@ -145,6 +197,7 @@ class MetricsRegistry:
     _timers: Dict[str, StepTimer] = field(default_factory=dict)
     _meters: Dict[str, ThroughputMeter] = field(default_factory=dict)
     _gauges: Dict[str, float] = field(default_factory=dict)
+    _hists: Dict[str, Histogram] = field(default_factory=dict)
 
     def counter_add(self, name: str, value: float = 1.0) -> None:
         with self._lock:
@@ -174,6 +227,14 @@ class MetricsRegistry:
                 self._meters[name] = ThroughputMeter()
             return self._meters[name]
 
+    def histogram(
+        self, name: str, buckets: Optional[List[float]] = None
+    ) -> Histogram:
+        with self._lock:
+            if name not in self._hists:
+                self._hists[name] = Histogram(buckets)
+            return self._hists[name]
+
     def snapshot(self) -> Dict[str, Dict[str, float]]:
         with self._lock:
             out: Dict[str, Dict[str, float]] = {
@@ -187,6 +248,8 @@ class MetricsRegistry:
                 out[f"timer/{name}"] = t.summary()
             for name, m in self._meters.items():
                 out[f"meter/{name}"] = m.summary()
+            for name, h in self._hists.items():
+                out[f"hist/{name}"] = h.summary()
             return out
 
     def reset(self) -> None:
@@ -195,6 +258,7 @@ class MetricsRegistry:
             self._timers.clear()
             self._meters.clear()
             self._gauges.clear()
+            self._hists.clear()
 
 
 metrics = MetricsRegistry()
@@ -312,6 +376,44 @@ def enrich_compile_error(
     metrics.counter_add("compile/failures")
     metrics.counter_add("compile/seconds", duration_s)
     return err
+
+
+def cost_analysis_summary(jitted, args, kwargs) -> Optional[Dict[str, float]]:
+    """Analytical FLOPs/bytes for one jitted function at given args.
+
+    ``jitted.lower(...)`` re-traces but does NOT backend-compile (the
+    live dispatch keeps its own jit cache), so calling this once at
+    first dispatch costs one extra trace, never a second XLA compile.
+    Returns ``{"flops", "bytes", "collective_bytes"}`` or None when the
+    running jax/backend exposes no cost analysis. ``collective_bytes``
+    sums the operand bytes of cross-replica ops when the analysis
+    reports them (TPU backends); 0.0 where it does not (CPU)."""
+    try:
+        cost = jitted.lower(*args, **kwargs).cost_analysis()
+    except Exception:
+        return None
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else None
+    if not isinstance(cost, dict):
+        return None
+    flops = float(cost.get("flops", 0.0) or 0.0)
+    nbytes = float(cost.get("bytes accessed", 0.0) or 0.0)
+    coll = 0.0
+    for key, value in cost.items():
+        # TPU analyses tag collective traffic with the op family in the
+        # key (e.g. "bytes accessed ... all-reduce"); nothing on CPU.
+        lk = key.lower()
+        if "bytes" in lk and any(
+            tag in lk for tag in ("all-reduce", "all-gather",
+                                  "collective", "reduce-scatter")
+        ):
+            try:
+                coll += float(value)
+            except (TypeError, ValueError):
+                pass
+    if flops <= 0.0 and nbytes <= 0.0:
+        return None
+    return {"flops": flops, "bytes": nbytes, "collective_bytes": coll}
 
 
 def sample_resource_gauges(registry: Optional[MetricsRegistry] = None) -> None:

@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""Diff two bench result JSONs and fail on throughput/MFU regressions.
+
+Usage::
+
+    python scripts/bench_compare.py OLD.json NEW.json [--threshold 0.10]
+
+Reads the per-config numeric leaves whose key names carry a rate
+(``*per_sec*``) or efficiency (``mfu``) meaning, matches them between
+the two files, and exits
+
+* ``0`` — no matched metric regressed more than ``threshold``
+  (default 10%);
+* ``1`` — at least one regression past the threshold (each is printed);
+* ``2`` — the files could not be compared (missing, unparseable, or no
+  overlapping metrics) — advisory for CI: distinguish "bench got
+  slower" from "bench output missing".
+
+Two on-disk shapes are accepted transparently:
+
+* the real ``bench.py`` result/partial shape — top-level ``configs`` /
+  ``cpu_matrix`` dicts of per-benchmark entries;
+* the driver wrapper shape — ``{"n", "cmd", "rc", "tail", "parsed"}``
+  where ``parsed`` (when non-null) holds the real shape. A wrapper
+  whose ``parsed`` is null has nothing comparable → exit 2.
+
+Higher is better for every matched metric (rates and MFU), so a
+regression is ``new < old × (1 - threshold)``. Metrics present in only
+one file are reported but never fail the comparison — benchmarks come
+and go across revisions.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, Optional, Tuple
+
+# Substrings of leaf keys that denote a higher-is-better metric.
+_RATE_MARKERS = ("per_sec",)
+_EXACT_KEYS = ("mfu",)
+
+# Sections of an entry that hold nested telemetry, not results — their
+# numeric leaves (e.g. meter/rows_per_sec gauges) are point-in-time
+# registry values, too noisy to gate on.
+_SKIP_SECTIONS = ("telemetry", "cluster_telemetry", "profile")
+
+
+def _unwrap(doc: Any) -> Optional[Dict[str, Any]]:
+    """Peel the driver wrapper; None when there is no result payload."""
+    if not isinstance(doc, dict):
+        return None
+    if "parsed" in doc and ("cmd" in doc or "rc" in doc):
+        parsed = doc.get("parsed")
+        return parsed if isinstance(parsed, dict) else None
+    return doc
+
+
+def _collect(
+    node: Any, prefix: str, out: Dict[str, float]
+) -> None:
+    if isinstance(node, dict):
+        for key, value in node.items():
+            if key in _SKIP_SECTIONS:
+                continue
+            path = f"{prefix}.{key}" if prefix else str(key)
+            if isinstance(value, dict):
+                _collect(value, path, out)
+            elif isinstance(value, (int, float)) and not isinstance(
+                value, bool
+            ):
+                lk = str(key).lower()
+                if lk in _EXACT_KEYS or any(
+                    m in lk for m in _RATE_MARKERS
+                ):
+                    out[path] = float(value)
+
+
+def extract_metrics(doc: Any) -> Dict[str, float]:
+    """``{dotted.path: value}`` for every rate/MFU leaf in the result."""
+    payload = _unwrap(doc)
+    metrics: Dict[str, float] = {}
+    if payload is None:
+        return metrics
+    for section in ("configs", "cpu_matrix", "chip_matrix"):
+        sub = payload.get(section)
+        if isinstance(sub, dict):
+            _collect(sub, section, metrics)
+    # A bare top-level value (the headline metric) counts too.
+    if isinstance(payload.get("value"), (int, float)) and payload.get(
+        "metric"
+    ):
+        metrics[str(payload["metric"])] = float(payload["value"])
+    return metrics
+
+
+def compare(
+    old: Dict[str, float], new: Dict[str, float], threshold: float
+) -> Tuple[list, list, list]:
+    """(regressions, improvements, only_in_one) over the common keys."""
+    regressions, improvements, lonely = [], [], []
+    for key in sorted(set(old) | set(new)):
+        if key not in old or key not in new:
+            lonely.append(key)
+            continue
+        o, n = old[key], new[key]
+        if o <= 0:
+            continue
+        ratio = n / o
+        if ratio < 1.0 - threshold:
+            regressions.append((key, o, n, ratio))
+        elif ratio > 1.0 + threshold:
+            improvements.append((key, o, n, ratio))
+    return regressions, improvements, lonely
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="compare two bench.py result files"
+    )
+    parser.add_argument("old", help="baseline result JSON")
+    parser.add_argument("new", help="candidate result JSON")
+    parser.add_argument(
+        "--threshold", type=float, default=0.10,
+        help="relative regression that fails (default 0.10 = 10%%)",
+    )
+    args = parser.parse_args(argv)
+
+    docs = []
+    for path in (args.old, args.new):
+        try:
+            with open(path) as f:
+                docs.append(json.load(f))
+        except (OSError, ValueError) as exc:
+            print(f"bench_compare: cannot read {path}: {exc}",
+                  file=sys.stderr)
+            return 2
+    old, new = (extract_metrics(d) for d in docs)
+    if not old or not new:
+        print(
+            "bench_compare: no comparable rate/MFU metrics "
+            f"(old={len(old)}, new={len(new)}) — nothing to gate on",
+            file=sys.stderr,
+        )
+        return 2
+    regressions, improvements, lonely = compare(
+        old, new, args.threshold
+    )
+    common = len(set(old) & set(new))
+    print(
+        f"bench_compare: {common} matched metric(s), "
+        f"threshold {args.threshold:.0%}"
+    )
+    for key, o, n, ratio in regressions:
+        print(f"  REGRESSION {key}: {o:,.2f} -> {n:,.2f} "
+              f"({(1 - ratio) * 100:.1f}% slower)")
+    for key, o, n, ratio in improvements:
+        print(f"  improved   {key}: {o:,.2f} -> {n:,.2f} "
+              f"(+{(ratio - 1) * 100:.1f}%)")
+    for key in lonely:
+        print(f"  unmatched  {key} (present in one file only)")
+    if not common:
+        print("bench_compare: no overlapping metrics", file=sys.stderr)
+        return 2
+    if regressions:
+        print(f"bench_compare: {len(regressions)} regression(s) past "
+              f"{args.threshold:.0%}", file=sys.stderr)
+        return 1
+    print("bench_compare: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

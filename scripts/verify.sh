@@ -64,4 +64,15 @@ assert "== Physical Plan ==" in text and "skew" in text, text
 PYEOF
   rm -rf "$smoke_dir"
 fi
+# Bench regression gate (ADVISORY): when two result files exist, diff
+# the newest pair; a >10% throughput/MFU regression prints loudly but
+# never fails the tier-1 gate (bench noise on shared CI boxes is real
+# — promote by dropping the `|| true` once runs are on quiet hardware).
+if [ "$rc" -eq 0 ]; then
+  mapfile -t bench_files < <(ls -t BENCH_r*.json BENCH_partial.json 2>/dev/null | head -2)
+  if [ "${#bench_files[@]}" -eq 2 ]; then
+    echo "--- bench regression check (advisory) ---"
+    python scripts/bench_compare.py "${bench_files[1]}" "${bench_files[0]}" || true
+  fi
+fi
 exit $rc
