@@ -1781,6 +1781,7 @@ _STATE = {
     "chip": {},       # name -> result (full-size on-accelerator run)
     "chip_device": None,
     "profile": None,  # --profile: merged gang trace path + phases
+    "analysis": None,  # raydpcheck wall-time (checker perf regression)
     "notes": [],
     "emitted": False,
 }
@@ -1836,9 +1837,35 @@ def _assemble() -> dict:
         out["chip_matrix"] = _STATE["chip"]
     if _STATE["profile"]:
         out["profile"] = _STATE["profile"]
+    if _STATE["analysis"]:
+        out["analysis"] = _STATE["analysis"]
     if _STATE["notes"]:
         out["note"] = "; ".join(_STATE["notes"])
     return out
+
+
+def _bench_static_analysis() -> None:
+    """Time a full raydpcheck pass over raydp_tpu/ so bench_compare
+    flags checker slowdowns like any other regression (files_per_sec is
+    a rate key it already diffs)."""
+    try:
+        from raydp_tpu.analysis import run_analysis
+
+        repo_root = os.path.dirname(os.path.abspath(__file__))
+        result = run_analysis([os.path.join(repo_root, "raydp_tpu")])
+        _STATE["analysis"] = {
+            "raydpcheck": {
+                "seconds": round(result.seconds, 3),
+                "files": result.files,
+                "findings": len(result.findings),
+                "files_per_sec": round(result.files / result.seconds, 1)
+                if result.seconds else None,
+            }
+        }
+    except Exception as exc:  # the checker must never sink the bench
+        _STATE["notes"].append(
+            f"raydpcheck bench failed: {type(exc).__name__}: {exc}"
+        )
 
 
 def _emit(partial: bool = False) -> None:
@@ -2226,6 +2253,7 @@ def main(argv=None):
             )
     if trace_out is not None:
         _write_trace_out(trace_out)
+    _bench_static_analysis()
     _emit()
     return 0
 

@@ -1,0 +1,38 @@
+"""raydpcheck: framework-aware static analysis for raydp_tpu.
+
+An AST-based rule engine tuned to the concurrency and telemetry idioms
+of THIS codebase — not a general linter. Every rule is grounded in a
+bug class the repo has already shipped and fixed by hand (see
+``doc/analysis.md`` for the catalogue and the history behind each):
+
+* **R1 lock-discipline** — lock-order inversions and locks held across
+  blocking calls (RPC send/recv, ``queue.get``, ``time.sleep``,
+  ``subprocess``, ``future.result()``), built from a per-module
+  lock-acquisition graph (the ``SPMDJob._rank_health`` class of race).
+* **R2 signal-safety** — the call graph reachable from registered
+  signal handlers must not acquire locks, log, or do unbounded
+  allocation (the PR 3 SIGTERM-deadlock class).
+* **R3 RPC-handler discipline** — handlers wired into :class:`RpcServer`
+  that (transitively) block must either be registered in the
+  long-stall set (``_LONG_HANDLER_METHODS``) or bracket the blocking
+  region with their own ``inflight()`` override.
+* **R4 telemetry consistency** — metric names must route to a
+  registered Prometheus family in ``telemetry/export.py`` or be
+  documented; every family and every ``RAYDP_TPU_*`` env var read in
+  code must appear in the docs.
+* **R5 JAX hazards** — host-device syncs inside jitted bodies and
+  step loops, and train-step jits missing ``donate_argnums``.
+
+Run it as ``python -m raydp_tpu.analysis [paths]``. Findings can be
+suppressed inline with ``# raydp: ignore[R1]`` (rule id or rule name)
+on the offending line or the line above, or accepted wholesale into a
+ratcheting baseline file (``--write-baseline``) so pre-existing debt
+never regresses while new code ships clean.
+"""
+from raydp_tpu.analysis.core import (  # noqa: F401
+    AnalysisResult,
+    Finding,
+    run_analysis,
+)
+
+__all__ = ["Finding", "AnalysisResult", "run_analysis"]

@@ -1,0 +1,216 @@
+"""R5 JAX hazards.
+
+Host↔device synchronization inside hot code is the quiet MFU killer on
+TPU: a single ``.item()`` in a step loop serializes the pipelined
+dispatch queue, and a train-step ``jit`` without buffer donation
+doubles parameter HBM. Checks:
+
+* ``host-sync-in-jit`` (error) — ``.item()``, ``float(x)``/``int(x)``
+  on non-literals, ``np.asarray``/``np.array``, and
+  ``.block_until_ready()`` inside a jit-compiled function body (these
+  either fail under tracing or silently force a sync).
+* ``device-put-in-jit`` (error) — ``jax.device_put`` inside a jitted
+  body (placement belongs outside the traced region).
+* ``host-sync-in-step-loop`` (warning) — per-iteration ``.item()`` /
+  ``block_until_ready()`` / ``device_put`` inside a training step
+  loop (a ``for``/``while`` in a function whose name mentions
+  train/fit/epoch/step). Profiling helpers are exempt: syncing before
+  reading a timer is the one legitimate use.
+* ``jit-missing-donation`` (warning) — a ``jax.jit(...)`` whose
+  target name contains ``step`` or ``update`` with no
+  ``donate_argnums``/``donate_argnames``.
+
+Jitted functions are found via decorators (``@jax.jit``, ``@jit``,
+``@partial(jax.jit, ...)``) and wrapper assignments
+(``f = jax.jit(g)``).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from raydp_tpu.analysis.callgraph import (
+    CallGraph,
+    FunctionInfo,
+    call_name,
+    walk_no_nested,
+)
+from raydp_tpu.analysis.core import Finding, ModuleInfo, Project
+
+RULE = "R5"
+
+_LOOPY_FN_HINTS = ("train", "fit", "epoch", "step_loop", "run_steps")
+_PROFILING_HINTS = ("profil", "bench", "timing", "measure", "trace",
+                    "warmup")
+_DONATE_TARGET_HINTS = ("step", "update")
+
+
+def _is_jit_name(dotted: str) -> bool:
+    last = dotted.rsplit(".", 1)[-1]
+    return last == "jit" or last == "pjit"
+
+
+def _jit_decorated(fn: FunctionInfo) -> bool:
+    node = fn.node
+    for dec in getattr(node, "decorator_list", []):
+        if isinstance(dec, ast.Call):
+            name = call_name(dec.func)
+            if _is_jit_name(name):
+                return True
+            if name.rsplit(".", 1)[-1] == "partial" and dec.args:
+                inner = call_name(dec.args[0])
+                if _is_jit_name(inner):
+                    return True
+        else:
+            if _is_jit_name(call_name(dec)):
+                return True
+    return False
+
+
+def _jit_wrapped(project: Project, graph: CallGraph) -> Set[str]:
+    """Functions passed to ``jax.jit(...)`` as the first argument
+    anywhere in the project → their qualnames."""
+    from raydp_tpu.analysis.rules_signals import _resolve_ref
+
+    out: Set[str] = set()
+    for mod in project.modules.values():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            if not _is_jit_name(call_name(node.func)):
+                continue
+            dotted = call_name(node.args[0])
+            if not dotted:
+                continue
+            fn = graph.enclosing_function(mod, node.lineno)
+            target = _resolve_ref(graph, mod, fn, dotted)
+            if target:
+                out.add(target)
+    return out
+
+
+def _profiling_context(fn: FunctionInfo) -> bool:
+    text = (fn.qualname + " " + fn.module.rel).lower()
+    return any(h in text for h in _PROFILING_HINTS)
+
+
+def check(project: Project) -> List[Finding]:
+    graph: CallGraph = project.graph
+    findings: List[Finding] = []
+    wrapped = _jit_wrapped(project, graph)
+
+    for qual, fn in graph.functions.items():
+        if isinstance(fn.node, ast.Lambda):
+            continue
+        if _jit_decorated(fn) or qual in wrapped:
+            _scan_jit_body(fn, findings)
+        if any(h in fn.node.name.lower() for h in _LOOPY_FN_HINTS) and \
+                not _profiling_context(fn):
+            _scan_step_loops(fn, findings)
+
+    _check_donation(project, graph, findings)
+    return findings
+
+
+def _iter_calls(stmts):
+    for stmt in stmts:
+        for node in walk_no_nested(stmt):
+            if isinstance(node, ast.Call):
+                yield node
+
+
+def _scan_jit_body(fn: FunctionInfo, findings: List[Finding]) -> None:
+    mod = fn.module
+    for node in _iter_calls(fn.node.body):
+        name = call_name(node.func)
+        last = name.rsplit(".", 1)[-1] if name else ""
+        msg = None
+        rname = "host-sync-in-jit"
+        if isinstance(node.func, ast.Attribute) and last == "item" \
+                and not node.args:
+            msg = "`.item()` inside a jitted body forces a host sync " \
+                  "(and fails under tracing)"
+        elif last in ("float", "int") and "." not in name and \
+                len(node.args) == 1 and \
+                not isinstance(node.args[0], ast.Constant):
+            msg = f"`{last}()` on a traced value inside a jitted body " \
+                  f"forces a host sync"
+        elif name in ("np.asarray", "np.array", "numpy.asarray",
+                      "numpy.array", "onp.asarray", "onp.array"):
+            msg = f"`{name}()` inside a jitted body pulls the value " \
+                  f"to host"
+        elif last == "block_until_ready":
+            msg = "`block_until_ready()` inside a jitted body is a " \
+                  "host sync"
+        elif last == "device_put":
+            msg = "`device_put` inside a jitted body; placement " \
+                  "belongs outside the traced region"
+            rname = "device-put-in-jit"
+        if msg:
+            findings.append(Finding(
+                rule=RULE, name=rname, severity="error",
+                path=mod.rel, line=node.lineno, col=node.col_offset,
+                message=msg, scope=fn.qualname,
+            ))
+
+
+def _scan_step_loops(fn: FunctionInfo, findings: List[Finding]) -> None:
+    mod = fn.module
+    seen: Set[Tuple[int, int]] = set()
+    for stmt in ast.walk(fn.node):
+        if not isinstance(stmt, (ast.For, ast.While, ast.AsyncFor)):
+            continue
+        for node in _iter_calls(stmt.body):
+            if (node.lineno, node.col_offset) in seen:
+                continue  # nested loops walk the same body twice
+            seen.add((node.lineno, node.col_offset))
+            name = call_name(node.func)
+            last = name.rsplit(".", 1)[-1] if name else ""
+            msg = None
+            if isinstance(node.func, ast.Attribute) and last == "item" \
+                    and not node.args:
+                msg = "`.item()` every iteration serializes dispatch; " \
+                      "accumulate on device and sync once per log " \
+                      "interval"
+            elif last == "block_until_ready":
+                msg = "`block_until_ready()` every iteration defeats " \
+                      "async dispatch (fine in profiling code only)"
+            elif last == "device_put":
+                msg = "`device_put` inside the step loop; stage inputs " \
+                      "ahead (prefetch) instead"
+            if msg:
+                findings.append(Finding(
+                    rule=RULE, name="host-sync-in-step-loop",
+                    severity="warning",
+                    path=mod.rel, line=node.lineno, col=node.col_offset,
+                    message=msg, scope=fn.qualname,
+                ))
+
+
+def _check_donation(project: Project, graph: CallGraph,
+                    findings: List[Finding]) -> None:
+    for mod in project.modules.values():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            if not _is_jit_name(call_name(node.func)):
+                continue
+            target = call_name(node.args[0])
+            last = target.rsplit(".", 1)[-1].lower() if target else ""
+            # only train/update steps benefit — donating into eval or
+            # predict steps would destroy the params they borrow
+            if "train" not in last or \
+                    not any(h in last for h in _DONATE_TARGET_HINTS):
+                continue
+            kws = {kw.arg for kw in node.keywords}
+            if kws & {"donate_argnums", "donate_argnames"}:
+                continue
+            fn = graph.enclosing_function(mod, node.lineno)
+            findings.append(Finding(
+                rule=RULE, name="jit-missing-donation", severity="warning",
+                path=mod.rel, line=node.lineno, col=node.col_offset,
+                message=f"jit of '{target}' without donate_argnums; "
+                        f"train-step params/opt-state should be donated "
+                        f"to halve HBM for the update",
+                scope=fn.qualname if fn else "",
+            ))

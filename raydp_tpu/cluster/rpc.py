@@ -42,9 +42,12 @@ def _identity(b: bytes) -> bytes:
     return b
 
 
-# Handler methods that run user code and so legitimately outlive the
-# default stall threshold; everything else is control-plane and fast.
-_LONG_HANDLER_METHODS = frozenset({"RunTask", "RunTaskBatch", "RunFunction"})
+# Handler methods that run user code (or, for ProfileRequest, sleep for
+# the requested capture window) and so legitimately outlive the default
+# stall threshold; everything else is control-plane and fast.
+_LONG_HANDLER_METHODS = frozenset(
+    {"RunTask", "RunTaskBatch", "RunFunction", "ProfileRequest"}
+)
 
 
 class RpcError(RuntimeError):

@@ -58,6 +58,9 @@ def ensure_built(verbose: bool = False) -> Optional[str]:
             for flags in flag_sets:
                 cmd = ["g++", *flags, "-shared", "-fPIC", "-o", tmp, *srcs]
                 try:
+                    # raydp: ignore[R1] — the lock intentionally covers
+                    # the compile so concurrent callers build exactly
+                    # once; callers tolerate the (bounded) wait.
                     subprocess.run(
                         cmd,
                         check=True,

@@ -181,9 +181,8 @@ class SPMDJob:
         # gang restarts (ranks keep their keys across incarnations).
         self.telemetry = ClusterTelemetry()
         # Watchdog stall flags shipped on rank Pings (empty = healthy).
-        # Guarded by its own lock, NOT self._lock: run() holds _lock for
-        # the whole dispatch (minutes), and Ping handlers must never
-        # block behind it.
+        # Guarded by its own lock, NOT self._lock: Ping handlers must
+        # never contend with dispatch bookkeeping.
         self._health_lock = threading.Lock()
         self._rank_health: Dict[str, dict] = {}
 
@@ -575,25 +574,37 @@ class SPMDJob:
                 f"per_rank_args has {len(per_rank_args)} entries for "
                 f"world_size {self.world_size}"
             )
+        # The lock covers only the inflight-slot claim: holding it across
+        # the send loop + gang wait (minutes) would block every other
+        # _lock user for the whole dispatch. A second concurrent run()
+        # now fails fast instead of silently queueing behind the lock.
         with self._lock:
+            if self._inflight is not None:
+                raise SPMDJobError(
+                    f"job {self.job_name} already has function "
+                    f"{self._inflight.func_id} in flight; SPMDJob.run() "
+                    f"is one-at-a-time"
+                )
             self._func_id += 1
-            _flight.record("dispatch", "start", job=self.job_name,
-                           func_id=self._func_id)
+            func_id = self._func_id
+            results = _FuncResults(func_id, self.world_size)
+            self._inflight = results
+        _flight.record("dispatch", "start", job=self.job_name,
+                       func_id=func_id)
+        try:
             # A gang that never reports back (rank wedged in a
             # collective) is attributed as "spmd/dispatch" on the driver
             # — pair it with health_report()'s per-rank flags to see
             # WHICH rank. The dispatch legitimately runs until its own
             # deadline, so the stall threshold is raised to match it.
             with _watchdog.inflight(
-                "spmd/dispatch", job=self.job_name, func_id=self._func_id,
+                "spmd/dispatch", job=self.job_name, func_id=func_id,
                 stall_after_s=timeout or max(self.timeout, 60.0),
             ), span("spmd/dispatch", job=self.job_name,
-                    func_id=self._func_id, world_size=self.world_size):
-                results = _FuncResults(self._func_id, self.world_size)
-                self._inflight = results
+                    func_id=func_id, world_size=self.world_size):
                 fn_blob = cloudpickle.dumps(fn)
                 for rank, stub in self._stubs.items():
-                    payload = {"func_id": self._func_id, "fn": fn_blob}
+                    payload = {"func_id": func_id, "fn": fn_blob}
                     # Deadline sized to the payload (fn closure + scatter
                     # blob) at a worst-case ~10 MB/s over DCN, on top of
                     # the control default — NOT the whole-job timeout,
@@ -609,10 +620,9 @@ class SPMDJob:
                     )
                 if not results.done.wait(timeout or max(self.timeout, 60.0)):
                     raise SPMDJobError(
-                        f"function {self._func_id} timed out on job "
+                        f"function {func_id} timed out on job "
                         f"{self.job_name}"
                     )
-                self._inflight = None
                 if self._failed:
                     raise SPMDJobError(
                         f"job {self.job_name} failed mid-function: "
@@ -627,6 +637,8 @@ class SPMDJob:
                         + "\n".join(errors)
                     )
                 return results.results
+        finally:
+            self._inflight = None
 
     def get_rank_addresses(self) -> List[str]:
         """Host of each rank, rank-ordered (reference: mpi_job.py:337-339)."""

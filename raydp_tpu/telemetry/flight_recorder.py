@@ -273,8 +273,10 @@ def dump_bundle(reason: str, *, exc: Optional[BaseException] = None,
             "pid": os.getpid(),
             "argv": list(sys.argv),
             "traceparent": _prop.to_traceparent(ctx) if ctx else None,
+            # raydp: ignore[R2] — blocking=False on the signal path
             "events": recorder.tail(blocking=not signal_safe),
             "stacks": all_thread_stacks(),
+            # raydp: ignore[R2] — snapshot skipped when signal_safe
             "metrics": {} if signal_safe else _metrics_snapshot(),
         }
         if exc is not None:

@@ -383,6 +383,8 @@ def _train_on_shard(
                 optimizer.step()
                 if scheduler is not None:
                     scheduler.step()
+                # raydp: ignore[R5] — CPU torch path; the per-batch
+                # scalar read costs nothing without a device queue
                 total += float(loss.item())
                 steps += 1
                 a = _accuracy(outputs, targets)

@@ -82,7 +82,7 @@ def extract_metrics(doc: Any) -> Dict[str, float]:
     metrics: Dict[str, float] = {}
     if payload is None:
         return metrics
-    for section in ("configs", "cpu_matrix", "chip_matrix"):
+    for section in ("configs", "cpu_matrix", "chip_matrix", "analysis"):
         sub = payload.get(section)
         if isinstance(sub, dict):
             _collect(sub, section, metrics)

@@ -40,6 +40,24 @@ if [ "$rc" -ne 0 ]; then
   echo "--- progress report ---"
   JAX_PLATFORMS=cpu python -c 'import json; from raydp_tpu.telemetry.progress import progress; print(json.dumps(progress.report()))' || true
 fi
+# Static analysis gate (HARD): raydpcheck must report zero
+# non-baselined findings over raydp_tpu/ (rules R1-R5, doc/analysis.md).
+# Budget <30s — it runs in ~2s; the JSON report ships on failure like
+# the other black boxes above.
+if [ "$rc" -eq 0 ]; then
+  echo "--- static analysis (raydpcheck) ---"
+  check_json="/tmp/raydpcheck.$$.json"
+  if timeout -k 5 30 python -m raydp_tpu.analysis raydp_tpu/ \
+      --json-out "$check_json"; then
+    echo "RAYDPCHECK=ok"
+  else
+    echo "RAYDPCHECK=failed"
+    echo "--- raydpcheck JSON report ---"
+    cat "$check_json" 2>/dev/null || echo "(no report written)"
+    rc=1
+  fi
+  rm -f "$check_json"
+fi
 # EXPLAIN ANALYZE smoke: a window->groupBy pipeline must profile end to
 # end and the analyze CLI must fold its stats shards into the report.
 if [ "$rc" -eq 0 ]; then
