@@ -1060,6 +1060,102 @@ def bench_dlrm_criteo_scale():
     }
 
 
+def bench_etl_overlap():
+    """Streaming pipelined execution vs the stage barrier: the same
+    ETL -> MLDataset -> fit pipeline as dlrm_criteo_scale (fewer rows)
+    run once with RAYDP_TPU_STREAMING=0 (every stage barriers on full
+    partition lists) and once streaming (narrow stages + epoch-0 ingest
+    consume partitions as their futures land). Reports both wall-clocks
+    plus the measured ETL/ingest overlap seconds and fraction."""
+    import optax
+    import pandas as pd
+
+    import raydp_tpu
+    import raydp_tpu.dataframe as rdf
+    from raydp_tpu.data.ml_dataset import MLDataset
+    from raydp_tpu.models.dlrm import DLRMConfig, PackedDLRM
+    from raydp_tpu.telemetry.overlap import OVERLAP_COUNTER
+    from raydp_tpu.train.estimator import JAXEstimator
+    from raydp_tpu.utils.profiling import metrics as _metrics
+
+    n_rows = 120_000 if _CPU_FALLBACK else 400_000
+    n_tables = 8
+    vocabs = tuple([10_000] * 2 + [1_000] * 6)
+    cfg = DLRMConfig(
+        vocab_sizes=vocabs, embed_dim=16, bottom_mlp=(64, 32, 16),
+        top_mlp=(64, 32),
+    )
+    rs = np.random.RandomState(11)
+    dense_cols = [f"d{i}" for i in range(cfg.dense_features)]
+    sparse_cols = [f"c{i}" for i in range(n_tables)]
+    pdf = pd.DataFrame(
+        {
+            **{c: rs.rand(n_rows).astype(np.float32) for c in dense_cols},
+            **{
+                c: rs.randint(0, vocabs[i], n_rows).astype(np.int32)
+                for i, c in enumerate(sparse_cols)
+            },
+            "click": (rs.rand(n_rows) < 0.25).astype(np.float32),
+        }
+    )
+
+    def run(streaming: bool):
+        prev = os.environ.get("RAYDP_TPU_STREAMING")
+        os.environ["RAYDP_TPU_STREAMING"] = "1" if streaming else "0"
+        session = raydp_tpu.init(
+            app_name=f"bench-overlap-{int(streaming)}", num_workers=4
+        )
+        try:
+            before = _metrics.snapshot()["counters"].get(OVERLAP_COUNTER, 0.0)
+            t0 = time.perf_counter()
+            df = rdf.from_pandas(pdf, num_partitions=8)
+            for c in dense_cols:
+                df = df.withColumn(c, rdf.col(c) * 2.0)
+            # num_shards=1: the epoch-0 prefix streamer serves rank 0 from
+            # the dataset prefix, so a single shard overlaps end-to-end.
+            ds = MLDataset.from_df(df, num_shards=1)
+            est = JAXEstimator(
+                model=PackedDLRM(cfg=cfg),
+                optimizer=optax.adagrad(1e-2),
+                loss="bce",
+                num_epochs=1,
+                batch_size=DLRM_BATCH,
+                feature_columns=dense_cols + sparse_cols,
+                label_column="click",
+                shuffle=False,
+                epoch_mode="stream",
+            )
+            history = est.fit(ds)
+            wall = time.perf_counter() - t0
+            after = _metrics.snapshot()["counters"].get(OVERLAP_COUNTER, 0.0)
+        finally:
+            raydp_tpu.stop()
+            if prev is None:
+                os.environ.pop("RAYDP_TPU_STREAMING", None)
+            else:
+                os.environ["RAYDP_TPU_STREAMING"] = prev
+        return wall, after - before, history[-1]["train_loss"]
+
+    barrier_wall, barrier_overlap, barrier_loss = run(streaming=False)
+    stream_wall, stream_overlap, stream_loss = run(streaming=True)
+    return {
+        "barriered_wall_s": round(barrier_wall, 2),
+        "streaming_wall_s": round(stream_wall, 2),
+        # Rate leaves (*_per_sec) are what scripts/bench_compare.py
+        # diffs between revisions — a streaming-path slowdown gates.
+        "streaming_rows_per_sec": round(n_rows / max(1e-9, stream_wall), 1),
+        "barriered_rows_per_sec": round(n_rows / max(1e-9, barrier_wall), 1),
+        "speedup": round(barrier_wall / max(1e-9, stream_wall), 3),
+        "overlap_seconds": round(stream_overlap, 3),
+        "overlap_fraction": round(stream_overlap / max(1e-9, stream_wall), 3),
+        "barriered_overlap_seconds": round(barrier_overlap, 3),
+        "rows": n_rows,
+        "tables": n_tables,
+        "train_loss_delta": round(abs(stream_loss - barrier_loss), 9),
+        "unit": "s",
+    }
+
+
 def bench_attention_kernels():
     """Raw attention-OP microbench: flash vs dense fwd+bwd at a constant
     token budget (batch = TOKENS // seq), H=8 D=64. The kernel-level
@@ -1751,6 +1847,9 @@ CPU_MATRIX = [
     ("titanic_classifier", bench_titanic),
     ("dlrm_embedding_study", bench_dlrm_embedding_study),
     ("dlrm_criteo_scale", bench_dlrm_criteo_scale),
+    # Host-side A/B of the streaming stage scheduler (barrier vs
+    # pipelined) — cluster + loader mechanics, full size in every mode.
+    ("etl_overlap", bench_etl_overlap),
     ("longcontext_seq_scaling", bench_longcontext),
     ("attention_kernels", bench_attention_kernels),
 ]

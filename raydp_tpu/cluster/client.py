@@ -39,6 +39,11 @@ from raydp_tpu.store.resolver import ObjectResolver
 logger = logging.getLogger(__name__)
 
 
+#: Sentinel outcome: the envelope thread resolved its futures inline
+#: (per-envelope streaming) — nothing left for the retry joiner to do.
+_BATCH_DONE = object()
+
+
 class ClientError(RuntimeError):
     pass
 
@@ -446,7 +451,26 @@ class RemoteCluster:
                             {"fns": fn_blobs, "tasks": tasks},
                             timeout=timeout,
                         )
-                        results[wid] = reply["results"]
+                        # Per-envelope streaming: resolve this worker's
+                        # futures the moment IT replies, not after the
+                        # slowest envelope joins.
+                        for i, res in zip(idxs, reply["results"]):
+                            if res.get("ok"):
+                                if meta_sink is not None:
+                                    try:
+                                        meta_sink(
+                                            i, wid, res.get("exec_s", 0.0)
+                                        )
+                                    except Exception:
+                                        pass
+                                futures[i].set_result(res.get("value"))
+                            else:
+                                futures[i].set_exception(RpcError(
+                                    f"batched task failed on {wid}: "
+                                    f"{res.get('error')}\n"
+                                    f"{res.get('traceback', '')}"
+                                ))
+                        results[wid] = _BATCH_DONE
                     except grpc.RpcError as exc:
                         if exc.code() in (grpc.StatusCode.UNAVAILABLE,
                                           grpc.StatusCode.CANCELLED):
@@ -477,28 +501,18 @@ class RemoteCluster:
                 next_pending: List[int] = []
                 for wid, idxs in groups.items():
                     outcome = results.get(wid)
+                    if outcome is _BATCH_DONE:
+                        continue
                     if isinstance(outcome, BaseException):
                         if getattr(outcome, "_hard", False):
                             raise outcome
                         last = outcome
                         next_pending.extend(idxs)
                         continue
-                    for i, res in zip(idxs, outcome):
-                        if res.get("ok"):
-                            if meta_sink is not None:
-                                try:
-                                    meta_sink(
-                                        i, wid, res.get("exec_s", 0.0)
-                                    )
-                                except Exception:
-                                    pass
-                            futures[i].set_result(res.get("value"))
-                        else:
-                            futures[i].set_exception(RpcError(
-                                f"batched task failed on {wid}: "
-                                f"{res.get('error')}\n"
-                                f"{res.get('traceback', '')}"
-                            ))
+                    raise ClientError(
+                        f"batch envelope to {wid} vanished without an "
+                        f"outcome"
+                    )
                 pending = next_pending
                 if not pending:
                     return

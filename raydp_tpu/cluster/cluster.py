@@ -68,6 +68,11 @@ class _WorkerGone(Exception):
     """Batch envelope lost to worker death; tasks are retriable."""
 
 
+#: Sentinel outcome: the envelope thread already resolved its futures
+#: inline (per-envelope streaming) — nothing left for the joiner to do.
+_BATCH_RESOLVED = object()
+
+
 class Cluster:
     def __init__(self, config: ClusterConfig):
         self.config = config
@@ -884,41 +889,33 @@ class Cluster:
                 for wid, idxs in groups.items():
                     t = threading.Thread(
                         target=self._call_batch_into,
-                        args=(results, wid, idxs, specs, staged, timeout),
+                        args=(results, wid, idxs, specs, staged, timeout,
+                              futures, meta_sink),
                         name=f"raydp-batch-{wid}",
                         daemon=True,
                     )
                     t.start()
                     threads.append(t)
+                # Futures resolve INSIDE each envelope thread the moment
+                # its worker replies (per-envelope streaming); this join
+                # only gates the retry round on the stragglers.
                 for t in threads:
                     t.join()
                 next_pending: List[int] = []
                 for wid, idxs in groups.items():
                     outcome = results.get(wid)
+                    if outcome is _BATCH_RESOLVED:
+                        continue
                     if isinstance(outcome, _WorkerGone):
                         last = ClusterError(str(outcome))
                         next_pending.extend(idxs)
                         continue
                     if isinstance(outcome, BaseException):
                         raise outcome
-                    for i, res in zip(idxs, outcome):
-                        if res.get("ok"):
-                            if meta_sink is not None:
-                                try:
-                                    meta_sink(
-                                        i, wid, res.get("exec_s", 0.0)
-                                    )
-                                except Exception:
-                                    pass  # sink must never fail the batch
-                            futures[i].set_result(res.get("value"))
-                        else:
-                            futures[i].set_exception(
-                                RpcError(
-                                    f"batched task failed on {wid}: "
-                                    f"{res.get('error')}\n"
-                                    f"{res.get('traceback', '')}"
-                                )
-                            )
+                    raise ClusterError(
+                        f"batch envelope to {wid} vanished without an "
+                        f"outcome"
+                    )
                 pending = next_pending
                 if not pending:
                     return
@@ -963,9 +960,16 @@ class Cluster:
         specs: List[TaskSpec],
         staged: List[List[Any]],
         timeout: float,
+        futures: Optional[List[Future]] = None,
+        meta_sink: Optional[Callable] = None,
     ) -> None:
-        """One RunTaskBatch envelope to one worker; outcome (per-task
-        result list, _WorkerGone, or a hard error) lands in ``results``."""
+        """One RunTaskBatch envelope to one worker. On success the
+        envelope's futures resolve HERE, the moment this worker replies
+        — not after every worker's thread is joined — so downstream
+        completion callbacks (streaming stages, ingest) fire while
+        slower envelopes are still running. ``results`` then carries the
+        resolved sentinel; failures (_WorkerGone / hard error) still
+        land there for the retry loop."""
         import grpc
 
         try:
@@ -1014,7 +1018,27 @@ class Cluster:
                 raise ClusterError(
                     f"batch RPC to worker {worker_id} failed: {code}"
                 ) from exc
-            results[worker_id] = reply["results"]
+            res_list = reply["results"]
+            if futures is None:
+                results[worker_id] = res_list
+                return
+            for i, res in zip(idxs, res_list):
+                if res.get("ok"):
+                    if meta_sink is not None:
+                        try:
+                            meta_sink(i, worker_id, res.get("exec_s", 0.0))
+                        except Exception:
+                            pass  # sink must never fail the batch
+                    futures[i].set_result(res.get("value"))
+                else:
+                    futures[i].set_exception(
+                        RpcError(
+                            f"batched task failed on {worker_id}: "
+                            f"{res.get('error')}\n"
+                            f"{res.get('traceback', '')}"
+                        )
+                    )
+            results[worker_id] = _BATCH_RESOLVED
         except BaseException as exc:  # noqa: BLE001 - marshalled to caller
             results[worker_id] = exc
 

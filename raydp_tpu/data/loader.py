@@ -35,6 +35,7 @@ import numpy as np
 from raydp_tpu.native import lib as native
 from raydp_tpu.telemetry import current_context, propagated, span
 from raydp_tpu.telemetry import flight_recorder as _flight
+from raydp_tpu.telemetry import overlap as _overlap
 from raydp_tpu.telemetry import progress as _progress
 from raydp_tpu.telemetry import watchdog as _watchdog
 from raydp_tpu.utils.profiling import metrics
@@ -74,6 +75,23 @@ def _pack_chunk(x: np.ndarray, y: np.ndarray) -> _PackedChunk:
     xb = np.ascontiguousarray(x).view(np.uint8).reshape(-1)
     yb = np.ascontiguousarray(y).view(np.uint8).reshape(-1)
     return _PackedChunk(np.concatenate([xb, yb]), x.shape[0])
+
+
+def _cut_rows(bufs: List[np.ndarray], lo: int, hi: int) -> np.ndarray:
+    """Rows [lo, hi) of the logical concatenation of ``bufs`` — a view
+    when the cut stays inside one buffer, a copy when it spans two."""
+    out = []
+    pos = 0
+    for b in bufs:
+        n = len(b)
+        if pos + n <= lo:
+            pos += n
+            continue
+        if pos >= hi:
+            break
+        out.append(b[max(0, lo - pos):min(n, hi - pos)])
+        pos += n
+    return out[0] if len(out) == 1 else np.concatenate(out)
 
 
 class JaxShardLoader:
@@ -209,7 +227,8 @@ class JaxShardLoader:
         )
 
     def _staged_chunks(
-        self, epoch: int, rows_per_chunk: int, pack: bool = False
+        self, epoch: int, rows_per_chunk: int, pack: bool = False,
+        start_row: int = 0,
     ) -> Iterator:
         """Gather the epoch's rows in ``rows_per_chunk`` pieces (a chunk
         is ``transfer_coalesce`` batches; 1 batch on the host path).
@@ -218,7 +237,11 @@ class JaxShardLoader:
         a :class:`_PackedChunk` — features and labels in one staging
         buffer — so the consumer ships it with a single device_put. The
         pack memcpy runs HERE, on the producer side, overlapping the
-        consumer's in-flight transfers."""
+        consumer's in-flight transfers.
+
+        ``start_row`` (chunk-aligned) skips rows the epoch-0 prefix
+        streamer already served — this generator finishes the epoch from
+        there."""
         matrix, labels = self._stage_matrix()
         n = matrix.shape[0]
         order = None
@@ -233,7 +256,8 @@ class JaxShardLoader:
         bytes_meter = metrics.meter("ingest/bytes")
         # Ingest shows up in /debug/progress like any plan stage: one
         # stage per epoch, one task per transfer chunk.
-        n_chunks = max(1, -(-n_used // rows_per_chunk)) if n_used else 0
+        remaining = max(0, n_used - start_row)
+        n_chunks = max(1, -(-remaining // rows_per_chunk)) if remaining else 0
         prog_id = _progress.stage_store.next_id()
         _progress.progress.stage_begin(
             prog_id, f"ingest[epoch {epoch}]", n_chunks
@@ -241,7 +265,7 @@ class JaxShardLoader:
         try:
             yield from self._chunk_iter(
                 epoch, rows_per_chunk, pack, matrix, labels, order, n_used,
-                rows_meter, bytes_meter, prog_id,
+                rows_meter, bytes_meter, prog_id, start_row,
             )
         finally:
             # finally (not loop-end): a consumer that stops early —
@@ -251,8 +275,9 @@ class JaxShardLoader:
             _progress.progress.stage_end(prog_id)
 
     def _chunk_iter(self, epoch, rows_per_chunk, pack, matrix, labels,
-                    order, n_used, rows_meter, bytes_meter, prog_id):
-        for lo in range(0, n_used, rows_per_chunk):
+                    order, n_used, rows_meter, bytes_meter, prog_id,
+                    start_row=0):
+        for lo in range(start_row, n_used, rows_per_chunk):
             hi = min(lo + rows_per_chunk, n_used)
             # The span closes before the yield: a suspended generator must
             # not hold an open span on this thread's stack while consumer
@@ -286,6 +311,115 @@ class JaxShardLoader:
             yield chunk
         _progress.progress.stage_end(prog_id)
 
+    def _stage_block(self, table) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        """One landed block → (feature matrix piece, labels piece), with
+        the same dtype pipeline as :meth:`_stage_matrix` so the streamed
+        prefix is bit-identical to the barriered epoch."""
+        feats = [
+            table.column(c).to_numpy(zero_copy_only=False)
+            for c in self.feature_columns
+        ]
+        n = table.num_rows
+        if self.feature_dtype in (np.dtype(np.float32), np.dtype(np.int32)):
+            m = native.gather_matrix(
+                feats, np.arange(n, dtype=np.int64),
+                out_dtype=self.feature_dtype,
+            )
+        else:
+            m = np.stack(
+                [f.astype(self.feature_dtype, copy=False) for f in feats],
+                axis=1,
+            )
+        y = None
+        if self.label_column:
+            y = table.column(self.label_column).to_numpy(
+                zero_copy_only=False
+            ).astype(self.label_dtype, copy=False)
+        return m, y
+
+    def _streaming_chunks(
+        self, epoch: int, rows_per_chunk: int, pack: bool
+    ) -> Iterator:
+        """Epoch-0 prefix streamer: start serving batches while LATE ETL
+        partitions are still being produced.
+
+        Only valid for rank 0 of an unshuffled epoch over an unshuffled
+        dataset: ``divide_blocks`` hands rank 0 the dataset prefix
+        ``[0, ceil(total/num_shards))``, so rows staged from the first
+        landed blocks ARE the head of this shard. ``known_rows()`` is a
+        monotone lower bound of ``total_rows``, hence
+        ``ceil(known/num_shards)`` never overshoots the shard end — whole
+        chunks below that bound are safe to emit before the plan exists.
+        Once every block has landed, the remainder of the epoch (and the
+        reusable epoch-1+ matrix) is delegated to :meth:`_staged_chunks`
+        with ``start_row`` pointing past what was already served."""
+        ds = self._dataset
+        shards = ds.num_shards
+        bs = self.batch_size
+        rows_meter = metrics.meter("ingest/rows")
+        bytes_meter = metrics.meter("ingest/bytes")
+        prog_id = _progress.stage_store.next_id()
+        _progress.progress.stage_begin(
+            prog_id, f"ingest[epoch {epoch} prefix]", 0
+        )
+        feat_bufs: List[np.ndarray] = []
+        label_bufs: List[np.ndarray] = []
+        staged = 0  # dataset-prefix rows staged into the buffers
+        emitted = 0  # rows already yielded
+        try:
+            for _idx, table in ds.iter_prefix_tables():
+                # Staging a landed block is ingest work that overlaps the
+                # still-running ETL tail — the overlap counter's bread
+                # and butter.
+                with _overlap.tracker.ingest(), \
+                     span("ingest/stream_block", rank=self._rank,
+                          rows=table.num_rows):
+                    m, y = self._stage_block(table)
+                feat_bufs.append(m)
+                if y is not None:
+                    label_bufs.append(y)
+                staged += table.num_rows
+                known, complete = ds.known_rows()
+                if complete:
+                    break
+                bound = min(staged, -(-known // shards))
+                bound -= bound % bs  # batch-aligned (drop_last-safe)
+                while emitted + rows_per_chunk <= bound:
+                    hi = emitted + rows_per_chunk
+                    with _watchdog.inflight("ingest/chunk", epoch=epoch,
+                                            rank=self._rank), \
+                         span("ingest/chunk", epoch=epoch, rank=self._rank,
+                              rows=rows_per_chunk, streamed=True):
+                        x = _cut_rows(feat_bufs, emitted, hi)
+                        yc = (
+                            _cut_rows(label_bufs, emitted, hi)
+                            if label_bufs else None
+                        )
+                        rows_meter.add(rows_per_chunk)
+                        bytes_meter.add(
+                            x.nbytes + (yc.nbytes if yc is not None else 0)
+                        )
+                        chunk = (
+                            _pack_chunk(x, yc)
+                            if pack and yc is not None else (x, yc)
+                        )
+                    _flight.record("loader", "chunk", epoch=epoch,
+                                   rank=self._rank, rows=rows_per_chunk,
+                                   streamed=True)
+                    _progress.progress.task_done(prog_id)
+                    emitted = hi
+                    yield chunk
+            metrics.counter_add("ingest/stream_prefix_rows", emitted)
+        finally:
+            feat_bufs.clear()
+            label_bufs.clear()
+            _progress.progress.stage_end(prog_id)
+        # Every block has landed: finish the epoch through the normal
+        # staged path (which also builds the epoch-1+ matrix).
+        yield from self._staged_chunks(
+            epoch, rows_per_chunk, pack, start_row=emitted
+        )
+
     def _unpack_device(self, buf, rows: int):
         """On-device recovery of (features, labels) from one packed
         buffer: slices + reshapes + bitcasts are async XLA ops on bytes
@@ -313,7 +447,28 @@ class JaxShardLoader:
         # Labeled device chunks are packed producer-side so each chunk is
         # exactly ONE device_put (unlabeled chunks already are).
         pack = device is not None and self.label_column is not None
-        source = self._staged_chunks(epoch, chunk_batches * bs, pack=pack)
+        source = None
+        if (
+            epoch == 0
+            and self._rank == 0
+            and not self.shuffle
+            and self._feat_matrix is None
+        ):
+            ds = self._dataset
+            if (
+                hasattr(ds, "has_pending_blocks")
+                and not getattr(ds, "shuffle", False)
+                and getattr(ds, "rank_nodes", None) is None
+                and ds.has_pending_blocks()
+            ):
+                from raydp_tpu.dataframe.scheduler import streaming_enabled
+
+                if streaming_enabled():
+                    source = self._streaming_chunks(
+                        epoch, chunk_batches * bs, pack
+                    )
+        if source is None:
+            source = self._staged_chunks(epoch, chunk_batches * bs, pack=pack)
         stop_event = None
         if self.prefetch > 0:
             # prefetch counts CHUNKS: with coalescing the host-side
@@ -326,14 +481,16 @@ class JaxShardLoader:
             if isinstance(chunk, _PackedChunk):
                 # Bracketed: a host→device transfer that never completes
                 # (remote-TPU link wedge) is a classic silent hang.
-                with _watchdog.inflight("ingest/device_put",
+                with _overlap.tracker.ingest(), \
+                     _watchdog.inflight("ingest/device_put",
                                         rank=self._rank):
                     buf = jax.device_put(chunk.buf, device)
                 batch_counter("ingest/device_puts")
                 return self._unpack_device(buf, chunk.rows)
             x, y = chunk
             if device is not None:
-                with _watchdog.inflight("ingest/device_put",
+                with _overlap.tracker.ingest(), \
+                     _watchdog.inflight("ingest/device_put",
                                         rank=self._rank):
                     x = jax.device_put(x, device)
                     y = jax.device_put(y, device) if y is not None else None
@@ -387,6 +544,11 @@ def _background(it: Iterator, depth: int):
     q: "queue.Queue" = queue.Queue(maxsize=depth)
     _DONE = object()
     stop = threading.Event()
+    # Producer errors surface PROMPTLY through this side channel: queueing
+    # the exception behind ``depth`` buffered items would make the
+    # consumer drain stale chunks first and report the failure a full
+    # prefetch window late.
+    err: List[BaseException] = []
     trace_ctx = current_context()
 
     def _put(item) -> bool:
@@ -406,7 +568,10 @@ def _background(it: Iterator, depth: int):
                         return
                 _put(_DONE)
             except BaseException as exc:  # surface errors on consumer side
-                _put(exc)
+                err.append(exc)
+                # Wake a consumer blocked on an empty queue; a full one
+                # means it will hit the err check on its next pull.
+                _put(_DONE)
 
     thread = threading.Thread(target=producer, daemon=True)
     thread.start()
@@ -417,15 +582,19 @@ def _background(it: Iterator, depth: int):
         # training loop sat idle waiting for data. The producer already
         # accounts its own pack/put time; this counter closes the gap.
         while True:
+            if err:
+                raise err[0]
             t0 = time.perf_counter()
             item = q.get()
             metrics.counter_add(
                 "ingest/wait_seconds", time.perf_counter() - t0
             )
+            if err:
+                # Raced with the failure while pulling: prefer the error
+                # over any still-buffered item.
+                raise err[0]
             if item is _DONE:
                 return
-            if isinstance(item, BaseException):
-                raise item
             yield item
 
     return consume(), stop

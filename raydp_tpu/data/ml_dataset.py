@@ -11,12 +11,18 @@ torch DataLoader (though ``to_torch`` exists for interop).
 from __future__ import annotations
 
 import math
+import threading
 from dataclasses import dataclass
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 import pyarrow as pa
 
+from raydp_tpu.dataframe.scheduler import (
+    PendingPartition,
+    is_pending,
+    resolve_one,
+)
 from raydp_tpu.store.object_store import ObjectRef, ObjectStore
 from raydp_tpu.utils.sharding import (
     BlockSlice,
@@ -47,45 +53,145 @@ class MLDataset:
     ):
         if not blocks:
             raise ValueError("MLDataset needs at least one block")
-        self.blocks = blocks
+        self._blocks = list(blocks)
         self.num_shards = num_shards
         self.shuffle = shuffle
         self.shuffle_seed = shuffle_seed
         self._store = store
-        self._block_sizes = [self._block_rows(b) for b in blocks]
+        self.rank_nodes = list(rank_nodes) if rank_nodes is not None else None
         if len(blocks) < num_shards:
             raise ValueError(
                 f"{len(blocks)} blocks cannot feed {num_shards} shards; "
                 "repartition the DataFrame first"
             )
-        # Locality-aware division when the consumer topology is known:
-        # rank_nodes[r] names the node rank r runs on; ref blocks carry
-        # their node, so shard plans keep bytes node-local (reference:
-        # locality-preferring shard selection, dataset.py:411-443).
-        self.block_nodes: List[Optional[str]] = [
-            b.node_id if isinstance(b, ObjectRef) else None for b in blocks
-        ]
-        self.rank_nodes = list(rank_nodes) if rank_nodes is not None else None
-        if self.rank_nodes is not None and any(
-            n is not None for n in self.block_nodes
-        ):
-            nodes = [n or "node-0" for n in self.block_nodes]
-            self.shard_plan: Dict[int, List[BlockSlice]] = divide_blocks_local(
-                self._block_sizes, num_shards, nodes, self.rank_nodes,
-                shuffle, shuffle_seed,
-            )
-        else:
-            self.shard_plan = divide_blocks(
-                self._block_sizes, num_shards, shuffle, shuffle_seed
-            )
+        # Streaming handoff: blocks may still be in-flight ETL tasks
+        # (PendingPartition). The shard plan needs every block's size, so
+        # it is DEFERRED until a consumer actually needs it
+        # (_ensure_plan) — the epoch-0 prefix streamer reads only the
+        # monotone lower bound in ``_known`` and never barriers.
+        self._plan_mu = threading.Lock()
+        self._known: List[Optional[int]] = []
+        for b in self._blocks:
+            if is_pending(b):
+                self._known.append(None)
+            elif isinstance(b, ObjectRef):
+                self._known.append(
+                    b.num_rows if b.num_rows >= 0 else None
+                )
+            else:
+                self._known.append(b.num_rows)
+        self._block_sizes: Optional[List[int]] = None
+        self.block_nodes: Optional[List[Optional[str]]] = None
+        self._shard_plan: Optional[Dict[int, List[BlockSlice]]] = None
+        for i, b in enumerate(self._blocks):
+            if is_pending(b):
+                b.future.add_done_callback(
+                    lambda f, i=i: self._note_block(i, f)
+                )
+        if not any(is_pending(b) for b in self._blocks):
+            self._ensure_plan()
+
+    @property
+    def blocks(self) -> List[Block]:
+        """Concrete blocks (ObjectRefs / tables) — the materialized view
+        every non-streaming consumer (store feed, SPMD fit, shard
+        readers) sees, so it BARRIERS on blocks still in flight.
+        Streaming consumers read ``known_rows()`` /
+        ``iter_prefix_tables()`` instead and never touch this."""
+        if any(is_pending(b) for b in self._blocks):
+            resolved = [resolve_one(b) for b in self._blocks]
+            with self._plan_mu:
+                self._blocks = resolved
+        return self._blocks
+
+    def has_pending_blocks(self) -> bool:
+        """True while any block is still an in-flight ETL partition."""
+        return any(
+            is_pending(b) and not b.future.done() for b in self._blocks
+        )
+
+    def known_rows(self) -> Tuple[int, bool]:
+        """(sum of block sizes known SO FAR, whether all are known).
+        The sum only grows as pending blocks land, so it is a safe lower
+        bound of ``total_rows`` — what the epoch-0 prefix streamer sizes
+        its emit limit with."""
+        with self._plan_mu:
+            vals = list(self._known)
+        return (
+            sum(v for v in vals if v is not None),
+            all(v is not None for v in vals),
+        )
+
+    def iter_prefix_tables(self) -> Iterator[Tuple[int, pa.Table]]:
+        """Yield ``(block_index, table)`` in block order, waiting on each
+        pending block IN ORDER — the dataset prefix streams out while
+        later blocks are still being produced."""
+        for i, b in enumerate(list(self._blocks)):
+            table = self._resolve(resolve_one(b))
+            with self._plan_mu:
+                if self._known[i] is None:
+                    self._known[i] = table.num_rows
+            yield i, table
+
+    def _note_block(self, i: int, fut) -> None:
+        """Done-callback of pending block ``i``: record its row count the
+        moment it lands (feeds ``known_rows``)."""
+        if fut.exception() is not None:
+            return
+        ref = fut.result()
+        rows = getattr(ref, "num_rows", -1)
+        if rows is None or rows < 0:
+            return  # unknowable without a fetch; prefix iteration fills it
+        with self._plan_mu:
+            if self._known[i] is None:
+                self._known[i] = int(rows)
+
+    def _ensure_plan(self) -> None:
+        """Barrier: resolve every block and build the shard plan. All
+        shard accessors funnel through here; until one does, a dataset
+        over pending blocks never blocks its creator."""
+        if self._shard_plan is not None:
+            return
+        # Resolve OUTSIDE the lock (arbitrarily long); idempotent, so a
+        # racing second consumer just re-resolves the same futures.
+        blocks = [resolve_one(b) for b in self._blocks]
+        sizes = [self._block_rows(b) for b in blocks]
+        with self._plan_mu:
+            if self._shard_plan is not None:
+                return
+            self._blocks = blocks
+            self._block_sizes = sizes
+            self._known = [int(s) for s in sizes]
+            # Locality-aware division when the consumer topology is
+            # known: rank_nodes[r] names the node rank r runs on; ref
+            # blocks carry their node, so shard plans keep bytes
+            # node-local (reference: locality-preferring shard
+            # selection, dataset.py:411-443).
+            self.block_nodes = [
+                b.node_id if isinstance(b, ObjectRef) else None
+                for b in blocks
+            ]
+            if self.rank_nodes is not None and any(
+                n is not None for n in self.block_nodes
+            ):
+                nodes = [n or "node-0" for n in self.block_nodes]
+                self._shard_plan = divide_blocks_local(
+                    sizes, self.num_shards, nodes, self.rank_nodes,
+                    self.shuffle, self.shuffle_seed,
+                )
+            else:
+                self._shard_plan = divide_blocks(
+                    sizes, self.num_shards, self.shuffle, self.shuffle_seed
+                )
 
     def locality(self) -> Optional[float]:
         """Fraction of planned samples that are node-local (None when no
         topology was supplied)."""
         if self.rank_nodes is None:
             return None
+        self._ensure_plan()
         nodes = [n or "node-0" for n in self.block_nodes]
-        return locality_fraction(self.shard_plan, nodes, self.rank_nodes)
+        return locality_fraction(self._shard_plan, nodes, self.rank_nodes)
 
     # -- constructors ---------------------------------------------------
     @staticmethod
@@ -108,7 +214,13 @@ class MLDataset:
 
         session = current_session()
         if session is not None:
-            refs = df.to_object_refs(owner_transfer=owner_transfer)
+            # Streaming handoff: partitions still being produced arrive
+            # as pending futures (owner transfer chained onto each), so
+            # to_jax() can ingest early blocks while late ETL partitions
+            # are in flight.
+            refs = df._to_block_parts(owner_transfer=owner_transfer)
+            if refs is None:
+                refs = df.to_object_refs(owner_transfer=owner_transfer)
             # The resolver (not the raw store) so blocks written on any
             # node of a multi-host cluster resolve from the driver.
             store = session.cluster.resolver
@@ -174,6 +286,7 @@ class MLDataset:
         import raydp_tpu.dataframe as rdf
         from raydp_tpu.context import current_session
 
+        self._ensure_plan()
         if all(isinstance(b, ObjectRef) for b in self.blocks):
             session = current_session()
             if session is not None:
@@ -185,7 +298,21 @@ class MLDataset:
 
     # -- introspection --------------------------------------------------
     @property
+    def shard_plan(self) -> Dict[int, List[BlockSlice]]:
+        """rank → block slices. Building it needs every block's size, so
+        the first read barriers on in-flight blocks."""
+        self._ensure_plan()
+        return self._shard_plan
+
+    @property
+    def block_sizes(self) -> List[int]:
+        """Per-block row counts (barriers on in-flight blocks)."""
+        self._ensure_plan()
+        return list(self._block_sizes)
+
+    @property
     def total_rows(self) -> int:
+        self._ensure_plan()
         return sum(self._block_sizes)
 
     @property
@@ -193,16 +320,18 @@ class MLDataset:
         return math.ceil(self.total_rows / self.num_shards)
 
     def schema(self) -> pa.Schema:
-        return self._resolve(self.blocks[0]).schema
+        # Only block 0 need exist — never barriers on the whole plan.
+        return self._resolve(resolve_one(self._blocks[0])).schema
 
     # -- shard access ---------------------------------------------------
     def shard_tables(self, rank: int) -> List[pa.Table]:
         """The (sliced) blocks assigned to ``rank``."""
-        if rank not in self.shard_plan:
+        self._ensure_plan()
+        if rank not in self._shard_plan:
             raise IndexError(f"rank {rank} out of {self.num_shards}")
         out = []
-        for s in self.shard_plan[rank]:
-            table = self._resolve(self.blocks[s.block_index])
+        for s in self._shard_plan[rank]:
+            table = self._resolve(self._blocks[s.block_index])
             if s.offset == 0 and s.num_samples == table.num_rows:
                 out.append(table)
             else:
@@ -220,7 +349,8 @@ class MLDataset:
         the equal-samples padding is a lockstep invariant of the
         reference's divide_blocks, python/raydp/utils.py:149-222, that
         must NOT leak into inference results.)"""
-        if rank not in self.shard_plan:
+        self._ensure_plan()
+        if rank not in self._shard_plan:
             raise IndexError(f"rank {rank} out of {self.num_shards}")
         starts = np.zeros(len(self._block_sizes), dtype=np.int64)
         if len(self._block_sizes) > 1:
@@ -228,7 +358,7 @@ class MLDataset:
         parts = [
             starts[s.block_index] + s.offset
             + np.arange(s.num_samples, dtype=np.int64)
-            for s in self.shard_plan[rank]
+            for s in self._shard_plan[rank]
         ]
         if not parts:
             return np.empty((0,), dtype=np.int64)
@@ -322,6 +452,7 @@ class MLDataset:
 
     # -- internals ------------------------------------------------------
     def _resolve(self, block: Block) -> pa.Table:
+        block = resolve_one(block)
         if isinstance(block, ObjectRef):
             store = self._store
             if store is not None:
@@ -332,6 +463,7 @@ class MLDataset:
         return block
 
     def _block_rows(self, block: Block) -> int:
+        block = resolve_one(block)
         if isinstance(block, ObjectRef):
             if block.num_rows < 0:
                 return self._resolve(block).num_rows

@@ -25,6 +25,12 @@ from raydp_tpu.dataframe.executor import (
     _concat,
     stage_label,
 )
+from raydp_tpu.dataframe.scheduler import (
+    chain as _chain_part,
+    is_pending as _is_pending,
+    resolve as _resolve_parts,
+    when_settled as _when_settled,
+)
 from raydp_tpu.telemetry.progress import stage_store
 from raydp_tpu.utils.profiling import metrics
 
@@ -608,7 +614,11 @@ class DataFrame:
                     remaining = 0
         limit_ctx.__exit__(None, None, None)
         if leftovers:
-            df._executor.discard(leftovers)
+            # The trim task consumes its source partition in flight —
+            # defer the leftover discard until the outputs settle.
+            _when_settled(
+                out_parts, lambda: df._executor.discard(leftovers)
+            )
         out = DataFrame(out_parts, df._executor)
         out._exchange_keys = df._exchange_keys  # prefix of partitions
         out._lineage = df._lineage + [
@@ -931,6 +941,11 @@ class DataFrame:
         partition-skew ratio. Returns the rendered text (and prints it
         unless ``quiet``)."""
         df = self._flush() if analyze else self
+        if analyze:
+            # Streaming stages record their StageStats when the LAST
+            # task lands; resolving the partitions guarantees that has
+            # happened before stats render.
+            df._parts = _resolve_parts(df._parts)
         text = _render_plan(df._lineage, analyze=analyze)
         if not quiet:
             print(text)
@@ -942,6 +957,7 @@ class DataFrame:
         rendered EXPLAIN ANALYZE text. The structured form is what the
         adaptive planner (and tests) consume."""
         df = self._flush()
+        df._parts = _resolve_parts(df._parts)  # stats land on completion
         nodes = []
         for node in df._lineage:
             stats = [
@@ -959,6 +975,11 @@ class DataFrame:
     def stage_stats(self) -> List[Any]:
         """StageStats records for every stage this frame's lineage has
         executed so far (lazy nodes contribute after a flush)."""
+        # Streaming stages record their stats when the last task lands,
+        # not when the stage is dispatched — settle in-flight partitions
+        # first so a post-flush read sees completed stages.
+        if any(_is_pending(p) for p in self._parts):
+            self._parts = _resolve_parts(self._parts)
         out = []
         for node in self._lineage:
             for sid in node["stage_ids"]:
@@ -1010,6 +1031,9 @@ class DataFrame:
         import pyarrow.parquet as pq
 
         df = self._flush()
+        # Part files are named by partition index: resolve pendings so
+        # the write tasks ship real refs/tables in index order.
+        df._parts = _resolve_parts(df._parts)
         # Workers run with their own cwd — anchor relative paths here.
         target_dir = os.path.abspath(path)
         os.makedirs(target_dir, exist_ok=True)
@@ -1055,7 +1079,7 @@ class DataFrame:
         from raydp_tpu.dataframe.executor import ClusterExecutor
 
         if isinstance(df._executor, ClusterExecutor):
-            refs = list(df._parts)
+            refs = _resolve_parts(list(df._parts))
             if owner_transfer:
                 store = df._executor.store
                 refs = [store.transfer_to_holder(r) for r in refs]
@@ -1070,6 +1094,23 @@ class DataFrame:
             )
         store = session.cluster.master.store
         return [store.put_arrow_table(t) for t in df.collect_partitions()]
+
+    def _to_block_parts(self, owner_transfer: bool = True):
+        """Streaming twin of :meth:`to_object_refs` for the MLDataset
+        handoff: partitions may still be pending ETL tasks, in which
+        case the owner transfer is chained onto their resolution instead
+        of barriering — ``to_jax()`` can start ingesting early blocks
+        while late ones are still being produced. Returns ``None`` when
+        this frame is not cluster-executed (caller falls back)."""
+        df = self._flush()
+        from raydp_tpu.dataframe.executor import ClusterExecutor
+
+        if not isinstance(df._executor, ClusterExecutor):
+            return None
+        if not owner_transfer:
+            return list(df._parts)
+        store = df._executor.store
+        return [_chain_part(p, store.transfer_to_holder) for p in df._parts]
 
 
 class GroupedData:
@@ -1773,10 +1814,11 @@ def _shuffle_join(
 
     with stage_label(f"join[{kstr}]") as jids:
         parts = left._executor.map_pairs(lparts, rparts, join_pair)
-    if l_tmp:
-        left._executor.discard(lparts)
-    if r_tmp:
-        left._executor.discard(rparts)
+    tmp = (lparts if l_tmp else []) + (rparts if r_tmp else [])
+    if tmp:
+        # Streaming join tasks fetch lparts/rparts asynchronously —
+        # free the temporaries only once every output has settled.
+        _when_settled(parts, lambda: left._executor.discard(tmp))
     out = DataFrame(parts, left._executor)
     out._exchange_keys = tkeys
     out._lineage = left._lineage + nodes + [_node(

@@ -27,6 +27,13 @@ from typing import Any, Callable, List, Optional, Sequence
 import pyarrow as pa
 
 from raydp_tpu.cluster.cluster import TaskSpec
+from raydp_tpu.dataframe.scheduler import (
+    PendingPartition,
+    StreamingStage,
+    resolve,
+    resolve_one,
+    streaming_enabled,
+)
 from raydp_tpu.store.object_store import ObjectRef, ObjectStore
 from raydp_tpu.telemetry import span
 from raydp_tpu.telemetry.progress import (
@@ -40,12 +47,14 @@ from raydp_tpu.utils.profiling import metrics
 StageFn = Callable[[pa.Table], pa.Table]
 
 
-def _stage_span(op: str, n_parts: int, executor: str):
+def _stage_span(op: str, n_parts: int, executor: str, **attrs):
     """Span + counter around one stage execution (driver side: covers
     submit AND result gather on the cluster backend, so the duration is
-    the stage's wall time as the query planner experiences it)."""
+    the stage's wall time as the query planner experiences it). Under
+    streaming dispatch the span covers scheduling only — completion
+    happens on callback threads and the true wall lands in StageStats."""
     metrics.counter_add("df/stages")
-    return span("df/stage", op=op, parts=n_parts, executor=executor)
+    return span("df/stage", op=op, parts=n_parts, executor=executor, **attrs)
 
 
 # -- per-stage runtime statistics ------------------------------------------
@@ -74,6 +83,10 @@ def stage_label(label: str):
 def _part_meta(part: Any) -> "tuple[int, int]":
     """(rows, bytes) of one partition without materializing it; rows is
     -1 when unknowable (refs stored without a row count)."""
+    if isinstance(part, PendingPartition):
+        if not part.future.done() or part.future.exception() is not None:
+            return -1, 0
+        part = part.future.result()
     if isinstance(part, ObjectRef):
         return part.num_rows, part.size
     if isinstance(part, pa.Table):
@@ -91,30 +104,44 @@ class _StageRecorder:
     existing task replies."""
 
     def __init__(self, op: str, parts_in: Sequence[Any], kind: str,
-                 total_tasks: Optional[int] = None):
+                 total_tasks: Optional[int] = None, streaming: bool = False):
         self.enabled = stage_stats_enabled()
         cur = getattr(_stage_ctx, "cur", None)
         self.op = cur[0] if cur else op
         self._ids_sink = cur[1] if cur else None
+        self._ids_sunk = False
         self.kind = kind
+        self.streaming = bool(streaming)
         self._t0 = time.perf_counter()
         self._dispatch_s = 0.0
         self._exec_s = 0.0
         self._workers: dict = {}
         self._mu = threading.Lock()
         self._outs: Optional[List[Any]] = None
+        self._rows_in = self._bytes_in = 0
+        self._out_meta: dict = {}
         self.stage_id = 0
         if not self.enabled:
             return
         self.stage_id = stage_store.next_id()
-        rows = nbytes = 0
-        for p in parts_in:
-            r, b = _part_meta(p)
-            if r > 0:
-                rows += r
-            nbytes += b
-        self._rows_in, self._bytes_in = rows, nbytes
         self._parts_in = len(parts_in)
+        if self.streaming:
+            # Inputs may still be pending futures: rows_in/bytes_in
+            # accrue per task at dispatch time (task_input), keeping the
+            # totals identical to the barriered path. The stage id must
+            # land in the label sink NOW — the planner copies that list
+            # into the lineage node before this stage completes.
+            if self._ids_sink is not None:
+                self._ids_sink.append(self.stage_id)
+                self._ids_sunk = True
+        else:
+            rows = nbytes = 0
+            for p in parts_in:
+                r, b = _part_meta(p)
+                if r > 0:
+                    rows += r
+                nbytes += b
+            self._rows_in, self._bytes_in = rows, nbytes
         total = total_tasks if total_tasks is not None else len(parts_in)
         progress.stage_begin(self.stage_id, self.op, total)
 
@@ -143,20 +170,59 @@ class _StageRecorder:
         if self.enabled:
             self._outs = list(parts_out)
 
+    def task_input(self, dep_parts: Sequence[Any]) -> None:
+        """Streaming mode: account one task's (resolved) inputs at
+        dispatch time — by then the upstream partitions exist, so the
+        stage totals match what the barriered path would have seen."""
+        if not self.enabled:
+            return
+        rows = nbytes = 0
+        for p in dep_parts:
+            r, b = _part_meta(p)
+            if r > 0:
+                rows += r
+            nbytes += b
+        with self._mu:
+            self._rows_in += rows
+            self._bytes_in += nbytes
+
+    def task_output(self, index: int, part: Any) -> None:
+        """Streaming mode: record one completed task's output partition
+        (keyed by index so skew stats stay order-stable regardless of
+        completion order)."""
+        if not self.enabled:
+            return
+        meta = _part_meta(part)
+        with self._mu:
+            self._out_meta[index] = meta
+
+    def close_streaming(self) -> None:
+        """Finalize a streaming stage: called by the scheduler after the
+        last task lands, BEFORE the final output future resolves."""
+        if not self.enabled:
+            return
+        with self._mu:
+            meta = dict(self._out_meta)
+        part_rows = [meta[i][0] for i in sorted(meta)]
+        part_bytes = [meta[i][1] for i in sorted(meta)]
+        self._emit(part_rows, part_bytes, len(meta))
+
     def close(self) -> None:
         if not self.enabled:
             return
-        wall = time.perf_counter() - self._t0
         part_rows: List[int] = []
         part_bytes: List[int] = []
-        rows_out = bytes_out = 0
         for p in self._outs or ():
             r, b = _part_meta(p)
             part_rows.append(r)
             part_bytes.append(b)
-            if r > 0:
-                rows_out += r
-            bytes_out += b
+        self._emit(part_rows, part_bytes, len(self._outs or ()))
+
+    def _emit(self, part_rows: List[int], part_bytes: List[int],
+              parts_out: int) -> None:
+        wall = time.perf_counter() - self._t0
+        rows_out = sum(r for r in part_rows if r > 0)
+        bytes_out = sum(part_bytes)
         # Queue time: stage wall minus driver dispatch minus measured
         # worker execution — the time tasks sat waiting for a slot.
         queue_s = max(0.0, wall - self._dispatch_s - self._exec_s)
@@ -169,7 +235,7 @@ class _StageRecorder:
             bytes_in=self._bytes_in,
             bytes_out=bytes_out,
             parts_in=self._parts_in,
-            parts_out=len(self._outs or ()),
+            parts_out=parts_out,
             wall_s=wall,
             dispatch_s=self._dispatch_s,
             queue_s=queue_s if self.kind == "cluster" else 0.0,
@@ -179,7 +245,7 @@ class _StageRecorder:
         )
         stage_store.record(stats)
         progress.stage_end(self.stage_id)
-        if self._ids_sink is not None:
+        if self._ids_sink is not None and not self._ids_sunk:
             self._ids_sink.append(self.stage_id)
         metrics.counter_add(f"stage/rows_in/{self.op}", self._rows_in)
         metrics.counter_add(f"stage/rows_out/{self.op}", rows_out)
@@ -371,7 +437,37 @@ class LocalExecutor(Executor):
             max_workers=max_threads or min(8, (os.cpu_count() or 2) * 2)
         )
 
+    def _stream_narrow(self, op, deps, call_of):
+        """Event-driven narrow stage on the thread pool: each output's
+        task runs the moment its upstream partitions exist; callers get
+        pending partitions immediately."""
+        rec = _StageRecorder(op, [d[0] for d in deps], "local",
+                             total_tasks=len(deps), streaming=True)
+
+        def run_one(i, vals):
+            rec.task_input(vals[:1])
+            out = call_of(i, vals)
+            rec.task_done()
+            return out
+
+        def submit(items):
+            return [self._pool.submit(run_one, i, vals)
+                    for i, vals in items]
+
+        stage = StreamingStage(deps, submit, on_output=rec.task_output,
+                               on_close=rec.close_streaming, op=op)
+        with _stage_span(op, len(deps), "local", streaming=True):
+            outs = stage.start()
+            rec.dispatched()
+        return outs
+
     def map_partitions(self, parts, fn):
+        if streaming_enabled() and parts:
+            return self._stream_narrow(
+                "map_partitions", [[p] for p in parts],
+                lambda i, vals: fn(vals[0]),
+            )
+        parts = resolve(parts)
         with _stage("map_partitions", parts, "local") as rec:
             def run(t):
                 out = fn(t)
@@ -383,6 +479,12 @@ class LocalExecutor(Executor):
             return outs
 
     def map_partitions_indexed(self, parts, fn):
+        if streaming_enabled() and parts:
+            return self._stream_narrow(
+                "map_partitions_indexed", [[p] for p in parts],
+                lambda i, vals: fn(vals[0], i),
+            )
+        parts = resolve(parts)
         with _stage("map_partitions_indexed", parts, "local") as rec:
             def run(t, i):
                 out = fn(t, i)
@@ -394,6 +496,14 @@ class LocalExecutor(Executor):
             return outs
 
     def map_pairs(self, parts_a, parts_b, fn):
+        if streaming_enabled() and parts_a:
+            return self._stream_narrow(
+                "map_pairs",
+                [[a, b] for a, b in zip(parts_a, parts_b)],
+                lambda i, vals: fn(vals[0], vals[1]),
+            )
+        parts_a = resolve(parts_a)
+        parts_b = resolve(parts_b)
         with _stage("map_pairs", parts_a, "local") as rec:
             def run(ta, tb):
                 out = fn(ta, tb)
@@ -405,6 +515,9 @@ class LocalExecutor(Executor):
             return outs
 
     def exchange(self, parts, splitter, n_out, combine=None):
+        # Wide stage: every input partition feeds every output bucket,
+        # so this is a true barrier — resolve pendings up front.
+        parts = resolve(parts)
         with _stage("exchange", parts, "local",
                     total_tasks=len(parts) + n_out) as rec:
             metrics.counter_add("shuffle/exchanges")
@@ -426,10 +539,10 @@ class LocalExecutor(Executor):
             return outs
 
     def part_nbytes(self, part):
-        return part.nbytes
+        return resolve_one(part).nbytes
 
     def run_coalesced(self, parts, fn, pre_concat=False):
-        parts = list(parts)
+        parts = resolve(list(parts))
         with _stage("run_coalesced", parts, "local", total_tasks=1) as rec:
             if not pre_concat:
                 out = fn(parts)
@@ -440,20 +553,23 @@ class LocalExecutor(Executor):
             return out
 
     def materialize(self, part):
-        return part
+        return resolve_one(part)
 
     def head(self, part, k):
+        part = resolve_one(part)
         return part.slice(0, min(k, part.num_rows))
 
     def put(self, table):
         return table
 
     def num_rows(self, part):
-        return part.num_rows
+        return resolve_one(part).num_rows
 
     def sample_column(self, parts, column, k):
         return [
-            vals for t in parts for vals in [_sample_table(t, column, k)]
+            vals
+            for t in resolve(parts)
+            for vals in [_sample_table(t, column, k)]
         ]
 
     def default_fanout(self) -> int:
@@ -494,11 +610,42 @@ class ClusterExecutor(Executor):
         ordered = sorted(w.worker_id for w in workers)
         return ordered[index % len(ordered)]
 
+    def _stream_narrow(self, op, deps, spec_of):
+        """Event-driven narrow stage: every output's task ships the
+        moment its upstream partitions exist. Each scheduler pump
+        batches ALL simultaneously-ready outputs into ONE submit_batch
+        call, so the one-RunTaskBatch-envelope-per-worker amortization
+        is preserved (the all-concrete case is exactly one batch)."""
+        rec = _StageRecorder(op, [d[0] for d in deps], "cluster",
+                             total_tasks=len(deps), streaming=True)
+
+        def submit(items):
+            for _i, vals in items:
+                rec.task_input(vals[:1])
+            specs = [spec_of(i, vals) for i, vals in items]
+            return self.cluster.submit_batch(specs, meta_sink=rec.task_meta)
+
+        stage = StreamingStage(deps, submit, on_output=rec.task_output,
+                               on_close=rec.close_streaming, op=op)
+        with _stage_span(op, len(deps), "cluster", streaming=True):
+            outs = stage.start()
+            rec.dispatched()
+        return outs
+
     def map_partitions(self, parts, fn):
         def task(ctx, ref):
             table = ctx.get_table(ref)
             return ctx.put_table(fn(table), holder=True)
 
+        if streaming_enabled() and parts:
+            return self._stream_narrow(
+                "map_partitions", [[p] for p in parts],
+                lambda i, vals: TaskSpec(
+                    task, (vals[0],),
+                    worker_id=self._worker_for(i, vals[0]),
+                ),
+            )
+        parts = resolve(parts)
         with _stage("map_partitions", parts, "cluster") as rec:
             # One RunTaskBatch envelope per worker (not per partition):
             # per-call gRPC+pickle overhead amortizes over all of that
@@ -517,6 +664,15 @@ class ClusterExecutor(Executor):
             table = ctx.get_table(ref)
             return ctx.put_table(fn(table, index), holder=True)
 
+        if streaming_enabled() and parts:
+            return self._stream_narrow(
+                "map_partitions_indexed", [[p] for p in parts],
+                lambda i, vals: TaskSpec(
+                    task, (vals[0], i),
+                    worker_id=self._worker_for(i, vals[0]),
+                ),
+            )
+        parts = resolve(parts)
         with _stage("map_partitions_indexed", parts, "cluster") as rec:
             futures = self.cluster.submit_batch([
                 TaskSpec(task, (ref, i), worker_id=self._worker_for(i, ref))
@@ -528,14 +684,31 @@ class ClusterExecutor(Executor):
             return outs
 
     def part_nbytes(self, part):
+        part = resolve_one(part)
         return part.size if isinstance(part, ObjectRef) else part.nbytes
 
     def discard(self, parts):
         for ref in parts:
-            if isinstance(ref, ObjectRef):
+            if isinstance(ref, PendingPartition):
+                # Free the partition whenever its producer lands; a
+                # failed producer has nothing to free.
+                ref.future.add_done_callback(self._discard_done)
+            elif isinstance(ref, ObjectRef):
                 self.store.delete(ref)
 
+    def _discard_done(self, fut) -> None:
+        if fut.exception() is not None:
+            return
+        ref = fut.result()
+        if isinstance(ref, ObjectRef):
+            try:
+                self.store.delete(ref)
+            except Exception:
+                pass
+
     def run_coalesced(self, parts, fn, pre_concat=False):
+        # Coalesced runs need every input in one task: barrier here.
+        parts = resolve(list(parts))
         if pre_concat:
             def task(ctx, refs):
                 # _fetch_concat_cached is resolved in the WORKER's own
@@ -583,6 +756,17 @@ class ClusterExecutor(Executor):
             tb = ctx.get_table(rb)
             return ctx.put_table(fn(ta, tb), holder=True)
 
+        if streaming_enabled() and parts_a:
+            return self._stream_narrow(
+                "map_pairs",
+                [[a, b] for a, b in zip(parts_a, parts_b)],
+                lambda i, vals: TaskSpec(
+                    task, (vals[0], vals[1]),
+                    worker_id=self._worker_for(i, vals[0]),
+                ),
+            )
+        parts_a = resolve(parts_a)
+        parts_b = resolve(parts_b)
         with _stage("map_pairs", parts_a, "cluster") as rec:
             futures = self.cluster.submit_batch([
                 TaskSpec(task, (ra, rb), worker_id=self._worker_for(i, ra))
@@ -658,6 +842,10 @@ class ClusterExecutor(Executor):
         except ValueError:
             eager_min = 0
 
+        # Wide stage: every split must exist before buckets can close —
+        # resolve pendings up front (the downstream merge dispatch is
+        # already streamed below).
+        parts = resolve(parts)
         with _stage("exchange", parts, "cluster",
                     total_tasks=len(parts) + n_out) as rec:
             metrics.counter_add("shuffle/exchanges")
@@ -746,9 +934,10 @@ class ClusterExecutor(Executor):
             return outs
 
     def materialize(self, part):
-        return self.cluster.resolver.get_arrow_table(part)
+        return self.cluster.resolver.get_arrow_table(resolve_one(part))
 
     def head(self, part, k):
+        part = resolve_one(part)
         if not isinstance(part, ObjectRef):
             return part.slice(0, min(k, part.num_rows))
 
@@ -769,8 +958,13 @@ class ClusterExecutor(Executor):
 
     def put_many(self, tables):
         # Scatter concurrently: ingest wall-clock is the slowest single
-        # transfer, not the sum.
-        return [f.result() for f in [self._put_async(t) for t in tables]]
+        # transfer, not the sum. Source frames stay concrete (refs, not
+        # pendings): ingest is driver-local put work, and downstream
+        # consumers — union coercion, to_object_refs, the store feed —
+        # rely on source partitions being addressable refs. Streaming
+        # starts at the first narrow STAGE over these refs.
+        futures = [self._put_async(t) for t in tables]
+        return [f.result() for f in futures]
 
     def _put_async(self, table):
         """Ingest a partition: scattered to a worker round-robin so initial
@@ -804,6 +998,7 @@ class ClusterExecutor(Executor):
         )
 
     def num_rows(self, part):
+        part = resolve_one(part)
         return part.num_rows if isinstance(part, ObjectRef) else -1
 
     def default_fanout(self) -> int:
@@ -815,6 +1010,7 @@ class ClusterExecutor(Executor):
         def task(ctx, ref):
             return _sample_table(ctx.get_table(ref), column, k)
 
+        parts = resolve(parts)
         futures = self.cluster.submit_batch([
             TaskSpec(task, (ref,), worker_id=self._worker_for(i, ref))
             for i, ref in enumerate(parts)

@@ -228,6 +228,13 @@ def render_prometheus(view: Dict[str, Any]) -> str:
         "Exchanges skipped by the co-partitioning planner because the "
         "frame's existing hash partitioning already co-located the keys.",
     )
+    pipeline_overlap = _Family(
+        "raydp_pipeline_overlap_seconds_total", "counter",
+        "Wall seconds during which ETL partition tasks and training "
+        "ingest (staging/device transfers) were in flight SIMULTANEOUSLY "
+        "— the time the streaming stage scheduler hid behind the "
+        "consumer. Zero under RAYDP_TPU_STREAMING=0.",
+    )
     stage_rows = _Family(
         "raydp_stage_rows_total", "counter",
         "Rows entering/leaving DataFrame stages, per plan-node label "
@@ -344,6 +351,11 @@ def render_prometheus(view: Dict[str, Any]) -> str:
                         # hit-rate and elision panels are one expression
                         # each (local/total ratio, elided rate).
                         shuffles_elided.add(
+                            {"worker": worker_id}, section[name]
+                        )
+                        continue
+                    if name == "pipeline/overlap_seconds":
+                        pipeline_overlap.add(
                             {"worker": worker_id}, section[name]
                         )
                         continue
@@ -468,7 +480,8 @@ def render_prometheus(view: Dict[str, Any]) -> str:
     lines: List[str] = []
     for family in (up, counters, meter_total, meter_rate, timers, dropped,
                    stalls, rpc_payload, shuffle_bytes, shuffle_local,
-                   shuffles_elided, stage_rows, stage_bytes, stage_seconds,
+                   shuffles_elided, pipeline_overlap, stage_rows,
+                   stage_bytes, stage_seconds,
                    compiles, compile_seconds, compile_failures, host_rss,
                    hbm_bytes, store_occupancy, mfu, anomalies, step_hist,
                    generic_hist, gauges):

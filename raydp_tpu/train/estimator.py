@@ -30,6 +30,7 @@ from raydp_tpu.telemetry import event as _event
 from raydp_tpu.telemetry import flush_spans, span
 from raydp_tpu.telemetry import device_profiler as _devplane
 from raydp_tpu.telemetry import flight_recorder as _flight
+from raydp_tpu.telemetry import overlap as _overlap
 from raydp_tpu.telemetry import watchdog as _watchdog
 from raydp_tpu.train.losses import resolve_loss, resolve_metric
 
@@ -495,16 +496,24 @@ class JAXEstimator:
             x_sharding = NamedSharding(mesh, P("dp", "sp"))
         else:
             x_sharding = self.data_sharding
-        if n_proc > 1:
-            xd = jax.make_array_from_process_local_data(x_sharding, x)
+        # Ingest bracket: sharded transfers that run while late ETL
+        # partitions are still producing accrue pipeline overlap credit.
+        with _overlap.tracker.ingest():
+            if n_proc > 1:
+                xd = jax.make_array_from_process_local_data(x_sharding, x)
+                yd = (
+                    jax.make_array_from_process_local_data(
+                        self.data_sharding, y
+                    )
+                    if y is not None else None
+                )
+                return xd, yd
+            xd = jax.device_put(x, x_sharding)
             yd = (
-                jax.make_array_from_process_local_data(self.data_sharding, y)
+                jax.device_put(y, self.data_sharding)
                 if y is not None else None
             )
             return xd, yd
-        xd = jax.device_put(x, x_sharding)
-        yd = jax.device_put(y, self.data_sharding) if y is not None else None
-        return xd, yd
 
     def _finish_epoch(
         self,

@@ -645,11 +645,11 @@ class TorchEstimator:
             return None
         if len(train_ds.blocks) < world:
             return None
-        plans = divide_blocks(train_ds._block_sizes, world)
+        plans = divide_blocks(train_ds.block_sizes, world)
         eval_plans = eval_true = None
         if evaluate_ds is not None:
             if len(evaluate_ds.blocks) >= world:
-                ep = divide_blocks(evaluate_ds._block_sizes, world)
+                ep = divide_blocks(evaluate_ds.block_sizes, world)
                 eval_plans = [ep[r] for r in range(world)]
                 padded = [
                     sum(s.num_samples for s in ep[r]) for r in range(world)
@@ -662,7 +662,7 @@ class TorchEstimator:
 
                 full = [
                     BlockSlice(i, n, 0)
-                    for i, n in enumerate(evaluate_ds._block_sizes)
+                    for i, n in enumerate(evaluate_ds.block_sizes)
                 ]
                 eval_plans = [full] + [None] * (world - 1)
                 eval_true = [evaluate_ds.total_rows] + [None] * (world - 1)

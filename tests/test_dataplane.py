@@ -99,7 +99,11 @@ def test_batched_stage_one_envelope_per_worker(session, rpc_spy):
         )
 
     rpc_spy.clear()
-    out_refs = ex.map_partitions(refs, double)
+    from raydp_tpu.dataframe.scheduler import resolve
+
+    # Streaming dispatch is async — settle the outputs before counting
+    # envelopes (the per-worker batching contract is unchanged).
+    out_refs = resolve(ex.map_partitions(refs, double))
     n_workers = len(session.cluster.alive_workers())
     assert rpc_spy.count("RunTask") == 0
     assert rpc_spy.count("RunTaskBatch") == n_workers == 2

@@ -1,0 +1,403 @@
+"""Streaming pipelined execution: the event-driven stage scheduler
+(dataframe/scheduler.py), the epoch-0 ingest prefix streamer, and the
+determinism guarantees that must survive out-of-order partition
+completion. RAYDP_TPU_STREAMING=0 must restore barriered semantics."""
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import raydp_tpu
+import raydp_tpu.dataframe as rdf
+from raydp_tpu.data.loader import _background
+from raydp_tpu.data.ml_dataset import MLDataset
+from raydp_tpu.dataframe import col
+from raydp_tpu.dataframe.scheduler import (
+    PendingPartition,
+    StreamingStage,
+    is_pending,
+    resolve,
+    streaming_enabled,
+)
+from raydp_tpu.telemetry.overlap import OVERLAP_COUNTER, OverlapTracker
+from raydp_tpu.utils.profiling import metrics
+
+
+# -- scheduler unit tests ------------------------------------------------
+
+def _run_stage(dep_futs, submit, **kw):
+    deps = [
+        [PendingPartition(f, i, "t") for f in row]
+        for i, row in enumerate(dep_futs)
+    ]
+    stage = StreamingStage(deps, submit, **kw)
+    return stage, stage.start()
+
+
+def test_streaming_stage_out_of_order_completion():
+    futs = [Future() for _ in range(4)]
+    order = []
+
+    def submit(items):
+        out = []
+        for i, vals in items:
+            order.append(i)
+            f = Future()
+            f.set_result(vals[0] * 10)
+            out.append(f)
+        return out
+
+    stage, outs = _run_stage([[f] for f in futs], submit)
+    # Resolve upstream in REVERSE order: dispatch follows completion
+    # order, but outputs stay slotted by index.
+    for i in reversed(range(4)):
+        futs[i].set_result(i + 1)
+    assert [o.future.result(timeout=5) for o in outs] == [10, 20, 30, 40]
+    assert order == [3, 2, 1, 0]
+
+
+def test_streaming_stage_window_bounds_inflight():
+    futs = [Future() for _ in range(6)]
+    task_futs = []
+    lock = threading.Lock()
+    high_water = [0]
+    live = [0]
+
+    def submit(items):
+        out = []
+        with lock:
+            live[0] += len(items)
+            high_water[0] = max(high_water[0], live[0])
+            for _i, _vals in items:
+                f = Future()
+                task_futs.append(f)
+                out.append(f)
+        return out
+
+    stage, outs = _run_stage([[f] for f in futs], submit, window=2)
+    for f in futs:
+        f.set_result(1)
+    # Drain tasks one at a time; the window must never exceed 2.
+    for _ in range(6):
+        deadline = time.time() + 5
+        while True:
+            with lock:
+                if task_futs:
+                    f = task_futs.pop(0)
+                    live[0] -= 1
+                    break
+            assert time.time() < deadline
+            time.sleep(0.005)
+        f.set_result(2)
+    for o in outs:
+        assert o.future.result(timeout=5) == 2
+    assert high_water[0] <= 2
+
+
+def test_streaming_stage_dep_failure_propagates():
+    ok, bad = Future(), Future()
+
+    def submit(items):
+        out = []
+        for _i, vals in items:
+            f = Future()
+            f.set_result(vals[0])
+            out.append(f)
+        return out
+
+    stage, outs = _run_stage([[ok], [bad]], submit)
+    ok.set_result(7)
+    bad.set_exception(RuntimeError("upstream died"))
+    assert outs[0].future.result(timeout=5) == 7
+    with pytest.raises(RuntimeError, match="upstream died"):
+        outs[1].future.result(timeout=5)
+
+
+def test_streaming_stage_on_close_after_all_outputs():
+    futs = [Future() for _ in range(3)]
+    seen = []
+    closed = []
+
+    def submit(items):
+        out = []
+        for i, vals in items:
+            f = Future()
+            f.set_result(vals[0])
+            out.append(f)
+        return out
+
+    stage, outs = _run_stage(
+        [[f] for f in futs], submit,
+        on_output=lambda i, r: seen.append(i),
+        on_close=lambda: closed.append(len(seen)),
+    )
+    for f in futs:
+        f.set_result(1)
+    for o in outs:
+        o.future.result(timeout=5)
+    deadline = time.time() + 5
+    while not closed and time.time() < deadline:
+        time.sleep(0.005)
+    # close fired exactly once, after every output was recorded.
+    assert closed == [3]
+
+
+def test_kill_switch_restores_barriered_parts(monkeypatch):
+    monkeypatch.setenv("RAYDP_TPU_STREAMING", "0")
+    assert not streaming_enabled()
+    from raydp_tpu.dataframe.executor import LocalExecutor
+    from raydp_tpu.dataframe.io import _distribute
+
+    df = _distribute(
+        [pa.table({"a": np.arange(4, dtype=np.int64)})],
+        executor=LocalExecutor(),
+    )
+    out = df.withColumn("b", col("a") * 2)
+    parts = out._flush()._parts
+    assert all(not is_pending(p) for p in parts)
+    monkeypatch.setenv("RAYDP_TPU_STREAMING", "1")
+    out2 = df.withColumn("b", col("a") * 2)
+    parts2 = out2._flush()._parts
+    assert any(is_pending(p) for p in parts2)
+    t1 = pa.concat_tables(resolve(parts))
+    t2 = pa.concat_tables(resolve(parts2))
+    assert t1.equals(t2)
+
+
+# -- overlap tracker -----------------------------------------------------
+
+def test_overlap_tracker_credits_concurrent_windows():
+    def counter():
+        return metrics.snapshot()["counters"].get(OVERLAP_COUNTER, 0.0)
+
+    tr = OverlapTracker()
+    before = counter()
+    tr.etl_begin()
+    with tr.ingest():
+        time.sleep(0.05)
+    tr.etl_end()
+    mid = counter()
+    # Ingest-only time (no ETL in flight) earns nothing.
+    with tr.ingest():
+        time.sleep(0.05)
+    after = counter()
+    assert mid - before >= 0.04
+    assert after - mid < 0.04
+
+
+# -- cluster: out-of-order completion must stay deterministic ------------
+
+@pytest.fixture(scope="module")
+def session():
+    s = raydp_tpu.init(app_name="streamtest", num_workers=2,
+                       memory_per_worker="256MB")
+    yield s
+    raydp_tpu.stop()
+
+
+def _make_reverse_stagger():
+    # A closure (not a module-level function): cloudpickle ships it BY
+    # VALUE, so cluster workers need not import the test module. Earlier
+    # partitions (smaller ids) sleep LONGER, so completion order is the
+    # reverse of partition order.
+    import time as _t
+
+    def _reverse_stagger(table):
+        first = table.column("id")[0].as_py()
+        _t.sleep(0.3 - min(0.25, first / 4000.0))
+        return table
+
+    return _reverse_stagger
+
+
+def test_out_of_order_partitions_deterministic(session, tmp_path):
+    df = rdf.range(4000, num_partitions=4).map_batches(_make_reverse_stagger())
+    tables = df.collect_partitions()
+    # collect_partitions: partition order == plan order, not completion
+    # order.
+    starts = [t.column("id")[0].as_py() for t in tables]
+    assert starts == sorted(starts)
+    assert pa.concat_tables(tables).column("id").to_pylist() == list(
+        range(4000)
+    )
+
+    out_dir = tmp_path / "pq"
+    df2 = rdf.range(4000, num_partitions=4).map_batches(_make_reverse_stagger())
+    df2.write_parquet(str(out_dir))
+    import pyarrow.parquet as pq
+
+    names = sorted(p.name for p in out_dir.iterdir())
+    assert names == [f"part-{i:05d}.parquet" for i in range(4)]
+    for i, name in enumerate(names):
+        t = pq.read_table(str(out_dir / name))
+        assert t.column("id")[0].as_py() == i * 1000
+
+
+def test_to_jax_batch_order_matches_barriered(session, monkeypatch):
+    def batches(streaming: str):
+        monkeypatch.setenv("RAYDP_TPU_STREAMING", streaming)
+        df = rdf.range(2000, num_partitions=4).map_batches(_make_reverse_stagger())
+        df = df.withColumn("x", col("id") * 2).withColumn(
+            "y", col("id") % 2
+        )
+        ds = MLDataset.from_df(df, num_shards=2)
+        loader = ds.to_jax(
+            ["id", "x"], "y", batch_size=128, rank=0, shuffle=False,
+            device=None, prefetch=2,
+        )
+        return [
+            (np.asarray(x), np.asarray(y)) for x, y in loader
+        ]
+
+    streamed = batches("1")
+    barriered = batches("0")
+    assert len(streamed) == len(barriered) > 0
+    for (x1, y1), (x2, y2) in zip(streamed, barriered):
+        np.testing.assert_array_equal(x1, x2)
+        np.testing.assert_array_equal(y1, y2)
+
+
+def test_cluster_streaming_overlap_counter(session):
+    # Task batches ship as ONE envelope per worker, and a future resolves
+    # when its envelope replies. Round-robin placement puts EVEN
+    # partitions on one worker and ODD on the other; sleeping only in odd
+    # partitions makes the even envelope land early, so the loader stages
+    # block 0 while the odd envelope's ETL tasks are still in flight.
+    def odd_sleeper():
+        import time as _t
+
+        def fn(table):
+            first = table.column("id")[0].as_py()
+            if (first // 25_000) % 2 == 1:
+                _t.sleep(0.7)
+            return table
+
+        return fn
+
+    before = metrics.snapshot()["counters"].get(OVERLAP_COUNTER, 0.0)
+    df = rdf.range(100_000, num_partitions=4).map_batches(odd_sleeper())
+    df = df.withColumn("x", col("id") * 2).withColumn("y", col("id") % 2)
+    ds = MLDataset.from_df(df, num_shards=1)
+    assert ds.has_pending_blocks()
+    loader = ds.to_jax(
+        ["id", "x"], "y", batch_size=512, rank=0, shuffle=False,
+        device=None, prefetch=2,
+    )
+    n = sum(1 for _ in loader)
+    assert n == -(-100_000 // 512)
+    after = metrics.snapshot()["counters"].get(OVERLAP_COUNTER, 0.0)
+    assert after > before
+
+
+# -- loader: epoch-0 prefix streaming ------------------------------------
+
+def _block_table(lo, hi):
+    idx = np.arange(lo, hi, dtype=np.float64)
+    return pa.table({"a": idx, "b": idx * 2, "y": (idx % 2)})
+
+
+def _pending_dataset(spans, delay, **kw):
+    futs = [Future() for _ in spans]
+
+    def resolver():
+        for f, (lo, hi) in zip(futs, spans):
+            time.sleep(delay)
+            f.set_result(_block_table(lo, hi))
+
+    threading.Thread(target=resolver, daemon=True).start()
+    blocks = [PendingPartition(f, i, "etl") for i, f in enumerate(futs)]
+    return MLDataset(blocks, **kw)
+
+
+def test_loader_prefix_streams_before_etl_finishes():
+    spans = [(i * 25, (i + 1) * 25) for i in range(8)]
+    ref_ds = MLDataset([_block_table(lo, hi) for lo, hi in spans],
+                       num_shards=2)
+    ref = list(ref_ds.to_jax(["a", "b"], "y", batch_size=16, rank=0,
+                             shuffle=False, device=None, prefetch=2))
+
+    ds = _pending_dataset(spans, delay=0.05, num_shards=2)
+    assert ds.has_pending_blocks()
+    loader = ds.to_jax(["a", "b"], "y", batch_size=16, rank=0,
+                       shuffle=False, device=None, prefetch=2)
+    t0 = time.perf_counter()
+    got, first_at = [], None
+    for b in loader:
+        if first_at is None:
+            first_at = time.perf_counter() - t0
+        got.append(b)
+    total = time.perf_counter() - t0
+    # The first batch must land while later blocks are still being
+    # produced (8 blocks x 50ms production ~= 0.4s).
+    assert first_at < total
+    assert first_at < 0.35
+    assert len(got) == len(ref)
+    for (x1, y1), (x2, y2) in zip(got, ref):
+        np.testing.assert_array_equal(np.asarray(x1), np.asarray(x2))
+        np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))
+    assert metrics.snapshot()["counters"].get(
+        "ingest/stream_prefix_rows", 0
+    ) > 0
+    # Epoch 1 runs the staged-matrix path and must agree too.
+    again = list(loader)
+    assert len(again) == len(ref)
+    for (x1, _), (x2, _) in zip(again, ref):
+        np.testing.assert_array_equal(np.asarray(x1), np.asarray(x2))
+
+
+def test_loader_prefix_respects_drop_last():
+    spans = [(0, 30), (30, 75), (75, 110)]  # 110 rows, ragged tail
+    ref_ds = MLDataset([_block_table(lo, hi) for lo, hi in spans],
+                       num_shards=1)
+    ref = list(ref_ds.to_jax(["a"], "y", batch_size=16, rank=0,
+                             shuffle=False, device=None, drop_last=True,
+                             prefetch=0))
+    ds = _pending_dataset(spans, delay=0.03, num_shards=1)
+    got = list(ds.to_jax(["a"], "y", batch_size=16, rank=0, shuffle=False,
+                         device=None, drop_last=True, prefetch=0))
+    assert len(got) == len(ref) == 110 // 16
+    for (x1, _), (x2, _) in zip(got, ref):
+        np.testing.assert_array_equal(np.asarray(x1), np.asarray(x2))
+
+
+def test_loader_kill_switch_skips_prefix_streamer(monkeypatch):
+    monkeypatch.setenv("RAYDP_TPU_STREAMING", "0")
+    spans = [(0, 40), (40, 80)]
+    ds = _pending_dataset(spans, delay=0.02, num_shards=1)
+    got = list(ds.to_jax(["a"], "y", batch_size=16, rank=0, shuffle=False,
+                         device=None, prefetch=0))
+    assert len(got) == 5
+    assert np.asarray(got[0][0])[0, 0] == 0.0
+
+
+# -- background prefetch: prompt producer-error surfacing ----------------
+
+def test_background_error_preempts_buffered_items():
+    release = threading.Event()
+
+    def gen():
+        yield "a"
+        release.wait(2)
+        yield "b"
+        raise ValueError("producer boom")
+
+    it, stop = _background(gen(), depth=4)
+    try:
+        assert next(it) == "a"
+        release.set()
+        time.sleep(0.3)  # "b" is buffered when the producer dies
+        with pytest.raises(ValueError, match="producer boom"):
+            next(it)
+    finally:
+        stop.set()
+
+
+def test_background_normal_drain_unchanged():
+    it, stop = _background(iter([1, 2, 3]), depth=1)
+    try:
+        assert list(it) == [1, 2, 3]
+    finally:
+        stop.set()
