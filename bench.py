@@ -1750,6 +1750,92 @@ def bench_device_plane():
     return out
 
 
+def bench_fault_tolerance():
+    """Recovery-cost evidence (doc/fault_tolerance.md): the same tiny
+    supervised ``fit_spmd`` run twice — clean, then with an injected
+    rank kill on a checkpoint boundary — and the delta reported as
+    MTTR (detection + backoff + relaunch + resume; replay is zero by
+    construction since the kill lands right after a mid-step save).
+    Loss parity between the arms is the correctness gate."""
+    import pandas as pd
+
+    import raydp_tpu.dataframe as rdf
+    from raydp_tpu.data import MLDataset
+    from raydp_tpu.train.spmd_fit import fit_spmd
+    from raydp_tpu.utils.profiling import metrics as _metrics
+
+    n_rows, batch = 2_048, 256
+    rs = np.random.RandomState(5)
+    a, b = rs.randn(n_rows), rs.randn(n_rows)
+    pdf = pd.DataFrame({"a": a, "b": b, "y": 2 * a - 3 * b + 1})
+    ds = MLDataset.from_df(
+        rdf.from_pandas(pdf, num_partitions=2), num_shards=1
+    )
+
+    def factory_builder(ckpt):
+        def make_estimator():
+            import jax
+            import optax
+
+            from raydp_tpu.models import MLP
+            from raydp_tpu.parallel import MeshSpec
+            from raydp_tpu.train import JAXEstimator
+
+            return JAXEstimator(
+                model=MLP(hidden=(16,), out_dim=1),
+                optimizer=optax.adam(3e-2),
+                loss="mse", num_epochs=2, batch_size=batch,
+                feature_columns=["a", "b"], label_column="y",
+                mesh=MeshSpec(dp=len(jax.devices())), seed=0,
+                shuffle=False, epoch_mode="stream",
+                checkpoint_dir=ckpt, save_every_steps=2,
+            )
+
+        return make_estimator
+
+    root = tempfile.mkdtemp(prefix="bench-ft-")
+    t0 = time.perf_counter()
+    clean = fit_spmd(
+        factory_builder(os.path.join(root, "clean")), ds, world_size=1,
+        env={"JAX_PLATFORMS": "cpu"}, timeout=300,
+    )
+    clean_s = time.perf_counter() - t0
+
+    chaos_ck = os.path.join(root, "chaos")
+    t0 = time.perf_counter()
+    chaos = fit_spmd(
+        factory_builder(chaos_ck), ds, world_size=1,
+        env={
+            "JAX_PLATFORMS": "cpu",
+            # step 4 is a save_every_steps boundary: the mid checkpoint
+            # commits, then the rank dies -> replay 0
+            "RAYDP_TPU_FAULT_PLAN": "kill:rank=0,step=4",
+        },
+        timeout=300, checkpoint_dir=chaos_ck,
+        restart_backoff_s=0.5,
+    )
+    chaos_s = time.perf_counter() - t0
+
+    counters = _metrics.snapshot().get("counters", {})
+    clean_loss = clean["history"][-1]["train_loss"]
+    chaos_loss = chaos["history"][-1]["train_loss"]
+    return {
+        "samples_per_sec": round(2 * n_rows / chaos_s, 1),
+        "unit": "samples/s",
+        "clean_s": round(clean_s, 3),
+        "chaos_s": round(chaos_s, 3),
+        "mttr_s": round(chaos_s - clean_s, 3),
+        "restarts": chaos["restarts"],
+        "replay_steps": int(counters.get("replay/steps", 0)),
+        "clean_loss": round(float(clean_loss), 6),
+        "chaos_loss": round(float(chaos_loss), 6),
+        "loss_parity": bool(
+            abs(chaos_loss - clean_loss) <= 1e-4 * abs(clean_loss)
+        ),
+        "baseline": "identical fit without RAYDP_TPU_FAULT_PLAN",
+    }
+
+
 def _capture_gang_profile() -> dict:
     """``--profile``: spin a 2-rank SPMD gang running a small stream
     fit and gang-capture a trace mid-training; the merged Perfetto path
@@ -1839,6 +1925,9 @@ CPU_MATRIX = [
     ("dataplane", bench_dataplane),
     # Phase-accounting overhead + fraction evidence (host-side fit).
     ("device_plane", bench_device_plane),
+    # Recovery cost (MTTR) of the supervised gang under an injected
+    # rank kill; host-side, loss parity is the correctness gate.
+    ("fault_tolerance", bench_fault_tolerance),
     # Ingest is bandwidth-sensitive: keep it ahead of the model configs
     # that leave host-memory pressure behind.
     ("ingest_device_feed", bench_ingest),

@@ -23,6 +23,7 @@ from __future__ import annotations
 import itertools
 import logging
 import os
+import random
 import threading
 import time
 from concurrent.futures import Future, ThreadPoolExecutor
@@ -46,6 +47,51 @@ _BATCH_DONE = object()
 
 class ClientError(RuntimeError):
     pass
+
+
+def _retry_idempotent(fn: Callable[[], Any], what: str) -> Any:
+    """Run an idempotent master RPC with jittered exponential backoff.
+
+    A briefly unreachable master (restarting container, transient
+    partition, LB blip) must not fail the client's first RPC — but only
+    IDEMPOTENT calls may be retried: a timed-out mutation could have
+    been applied, and re-sending it would double-apply. Read-only calls
+    (Ping, ListWorkers, GetObjectMeta, …) are safe to re-send verbatim.
+
+    ``RAYDP_TPU_CLIENT_RETRIES`` attempts (default 4) with base delay
+    ``RAYDP_TPU_CLIENT_BACKOFF_S`` (default 0.25) doubling per attempt,
+    plus up to 25% jitter so a fleet of reconnecting clients doesn't
+    stampede the recovering master in lockstep.
+    """
+    import grpc
+
+    try:
+        retries = max(0, int(os.environ.get("RAYDP_TPU_CLIENT_RETRIES", "4")))
+    except ValueError:
+        retries = 4
+    try:
+        backoff = float(os.environ.get("RAYDP_TPU_CLIENT_BACKOFF_S", "0.25"))
+    except ValueError:
+        backoff = 0.25
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except grpc.RpcError as exc:
+            # Transport-level failure only: an RpcError (remote handler
+            # raised) means the master IS reachable — retrying a
+            # handler exception would just repeat it.
+            if attempt >= retries:
+                raise
+            delay = backoff * (2 ** attempt)
+            delay *= 1.0 + random.uniform(0.0, 0.25)
+            attempt += 1
+            code = getattr(exc, "code", lambda: "?")()
+            logger.warning(
+                "client: %s unreachable (%s); retry %d/%d in %.2fs",
+                what, code, attempt, retries, delay,
+            )
+            time.sleep(delay)
 
 
 class _RemoteStore:
@@ -119,25 +165,47 @@ class _RemoteMaster:
         self.namespace = namespace
         self.store = _RemoteStore(client, namespace)
 
+    # Read-only lookups retry through master blips (idempotent: the
+    # identical request can be re-sent with no double-apply risk).
+    # Mutations (PutObject, RegisterObject, TransferToHolder) do NOT —
+    # a timed-out mutation may have landed, and the caller must decide.
     def object_meta(self, object_id: str):
-        reply = self._client.call("GetObjectMeta", {"object_id": object_id})
+        reply = _retry_idempotent(
+            lambda: self._client.call("GetObjectMeta", {"object_id": object_id}),
+            "master GetObjectMeta",
+        )
         return reply.get("ref"), reply.get("agent")
 
     def alive_workers(self) -> List[WorkerInfo]:
-        workers = self._client.call("ListWorkers", {})["workers"]
+        workers = _retry_idempotent(
+            lambda: self._client.call("ListWorkers", {}),
+            "master ListWorkers",
+        )["workers"]
         return [w for w in workers if w.state == "ALIVE"]
 
     def cluster_resources(self) -> dict:
-        return self._client.call("ClusterResources", {})
+        return _retry_idempotent(
+            lambda: self._client.call("ClusterResources", {}),
+            "master ClusterResources",
+        )
 
     def metrics_snapshot(self) -> dict:
-        return self._client.call("MetricsSnapshot", {})["snapshot"]
+        return _retry_idempotent(
+            lambda: self._client.call("MetricsSnapshot", {}),
+            "master MetricsSnapshot",
+        )["snapshot"]
 
     def health_report(self) -> dict:
-        return self._client.call("HealthReport", {})["report"]
+        return _retry_idempotent(
+            lambda: self._client.call("HealthReport", {}),
+            "master HealthReport",
+        )["report"]
 
     def progress_report(self) -> dict:
-        return self._client.call("ProgressReport", {})["report"]
+        return _retry_idempotent(
+            lambda: self._client.call("ProgressReport", {}),
+            "master ProgressReport",
+        )["report"]
 
     def mark_worker_dead(self, worker_id: str, reason: str = "") -> None:
         # Best-effort: the real master's own monitors are authoritative;
@@ -153,7 +221,13 @@ class RemoteCluster:
     def __init__(self, master_address: str):
         self.master_address = master_address
         self._client = RpcClient(master_address, SERVICE)
-        reply = self._client.call("Ping", {})
+        # The connect handshake retries: attaching while the master is
+        # briefly unreachable (restart, partition) should wait it out,
+        # not fail the session's very first RPC. Ping is idempotent.
+        reply = _retry_idempotent(
+            lambda: self._client.call("Ping", {}),
+            f"master {master_address}",
+        )
         self.namespace = reply["namespace"]
         self.master = _RemoteMaster(self._client, self.namespace)
         self._pool = ThreadPoolExecutor(max_workers=32)

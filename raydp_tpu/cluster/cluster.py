@@ -90,6 +90,11 @@ class Cluster:
         self._pool = ThreadPoolExecutor(max_workers=32)
         self._resolver = None
         self._restarts_used = 0
+        # Per-worker-lineage restart timestamps (monotonic) for the
+        # sliding-window budget; a respawned worker inherits its
+        # predecessor's list so a crash-looping worker exhausts its OWN
+        # budget without starving respawns of healthy workers.
+        self._restart_history: Dict[str, List[float]] = {}
         self._elastic_stop = threading.Event()
         self._elastic_thread: Optional[threading.Thread] = None
         self._trace_ctx = None
@@ -222,9 +227,27 @@ class Cluster:
         """Crash recovery (reference: executor reschedule on disconnect,
         RayAppMaster.scala:184-186 + schedule() re-request): a worker
         process that EXITS without being stopped by us is marked dead and
-        respawned on its node, up to ``max_worker_restarts``. Intentional
-        stops pop the proc from ``_procs`` first, so they never trip this.
+        respawned on its node. Intentional stops pop the proc from
+        ``_procs`` first, so they never trip this.
+
+        The restart budget is a PER-WORKER sliding window:
+        ``max_worker_restarts`` restarts within
+        ``RAYDP_TPU_RESTART_WINDOW_S`` seconds (default 600), tracked
+        per lineage — the respawn inherits its predecessor's history.
+        A crash-looping worker burns through its own window and stays
+        down; an unrelated healthy worker that crashes later still gets
+        its full budget (a global counter would have starved it).
+        Restarts are exported as ``raydp_worker_restarts_total{worker}``.
         """
+        from raydp_tpu.utils.profiling import metrics as _metrics
+
+        window_s = 600.0
+        raw = os.environ.get("RAYDP_TPU_RESTART_WINDOW_S")
+        if raw:
+            try:
+                window_s = float(raw)
+            except ValueError:
+                pass
         while not self._elastic_stop.wait(0.5):
             with self._lock:
                 exited = [
@@ -238,8 +261,12 @@ class Cluster:
                         continue  # stopped/replaced concurrently
                     self._procs.pop(wid, None)
                     node = self._worker_nodes.get(wid)
-                    allow = self._restarts_used < self.config.max_worker_restarts
+                    now = time.monotonic()
+                    history = self._restart_history.setdefault(wid, [])
+                    history[:] = [t for t in history if now - t < window_s]
+                    allow = len(history) < self.config.max_worker_restarts
                     if allow:
+                        history.append(now)
                         self._restarts_used += 1
                 if self.master is None:
                     return
@@ -247,15 +274,23 @@ class Cluster:
                     wid, reason=f"process exited rc={proc.returncode}"
                 )
                 if allow:
+                    _metrics.counter_add(f"worker_restarts/{wid}")
                     new_id = self._spawn_worker(node_id=node)
+                    with self._lock:
+                        # Lineage carry-over: if the respawn crash-loops,
+                        # it exhausts this same window, not a fresh one.
+                        self._restart_history[new_id] = history
                     logger.warning(
-                        "worker %s crashed (rc=%s); respawned as %s on %s",
+                        "worker %s crashed (rc=%s); respawned as %s on %s "
+                        "(%d/%d restarts in window)",
                         wid, proc.returncode, new_id, node,
+                        len(history), self.config.max_worker_restarts,
                     )
                 else:
                     logger.error(
-                        "worker %s crashed; restart budget (%d) exhausted",
-                        wid, self.config.max_worker_restarts,
+                        "worker %s crashed; its restart budget (%d in "
+                        "%.0fs window) is exhausted",
+                        wid, self.config.max_worker_restarts, window_s,
                     )
 
     def _spawn_agents(self) -> None:

@@ -32,6 +32,7 @@ from typing import Any, Callable, Dict, Optional
 import cloudpickle
 import grpc
 
+from raydp_tpu import fault as _fault
 from raydp_tpu.telemetry import flight_recorder as _flight
 from raydp_tpu.telemetry import propagation as _prop
 from raydp_tpu.telemetry import watchdog as _watchdog
@@ -52,6 +53,25 @@ _LONG_HANDLER_METHODS = frozenset(
 
 class RpcError(RuntimeError):
     """Remote handler raised; message carries the remote traceback."""
+
+
+class FaultInjectedRpcError(grpc.RpcError):
+    """An ``rpc_drop`` fault-plan clause dropped this call.
+
+    Subclasses ``grpc.RpcError`` so every existing transport-error
+    path (``try_call``, heartbeat miss accounting, client retries)
+    treats an injected drop exactly like a real UNAVAILABLE peer.
+    """
+
+    def __init__(self, method: str):
+        super().__init__(f"fault plan dropped rpc {method}")
+        self._method = method
+
+    def code(self):
+        return grpc.StatusCode.UNAVAILABLE
+
+    def details(self) -> str:
+        return f"fault plan dropped rpc {self._method}"
 
 
 class RpcServer:
@@ -207,6 +227,12 @@ class RpcClient:
         # raydp_rpc_payload_bytes; asserted small in tests).
         _metrics.counter_add("rpc/payload_bytes", len(request_bytes))
         try:
+            # Fault-plan hook: an rpc_delay clause sleeps here (inside the
+            # watchdog bracket, so a big injected delay is attributed to
+            # this call); an rpc_drop clause turns the send into a
+            # synthetic UNAVAILABLE before any bytes hit the wire.
+            if _fault.active() and _fault.on_rpc(qualified) == "drop":
+                raise FaultInjectedRpcError(qualified)
             reply_bytes = stub(request_bytes, timeout=eff_timeout)
         except Exception as exc:
             _flight.record(

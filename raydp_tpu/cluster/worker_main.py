@@ -24,6 +24,7 @@ import traceback
 
 import cloudpickle
 
+from raydp_tpu import fault as _fault
 from raydp_tpu.cluster.rpc import RpcClient, RpcServer
 from raydp_tpu.store.object_store import ObjectStore
 from raydp_tpu.telemetry import MetricsShipper, flush_spans, span
@@ -108,6 +109,10 @@ class Worker:
         # the in-flight RunTask on the driver side.
         self._busy = 0
         self._busy_lock = threading.Lock()
+        # Monotonic count of tasks this process has started (single and
+        # batched alike) — the index the fault plan's kill task= clause
+        # matches against.
+        self._task_seq = 0
         # Telemetry: each heartbeat carries the registry sections that
         # changed since the previous beat (delta-encoded snapshot).
         self._shipper = MetricsShipper()
@@ -189,6 +194,7 @@ class Worker:
             # the tables are resolved here (zero-copy from local shm when
             # co-located with the submitter, chunked agent fetch if not).
             data = self._resolve_data_refs(req.get("data_refs", ()))
+            self._fault_task_hook()
             metrics.counter_add("worker/tasks")
             _flight.record("task", "start", worker_id=self.worker_id)
             # RpcServer already installed the caller's traceparent as
@@ -220,6 +226,14 @@ class Worker:
 
     def _resolve_data_refs(self, refs):
         return [self.ctx.get_table(r) for r in refs]
+
+    def _fault_task_hook(self) -> None:
+        """Fault-plan hook at each task start (kill worker=…,task=K)."""
+        with self._busy_lock:
+            seq = self._task_seq
+            self._task_seq += 1
+        if _fault.active():
+            _fault.on_task(self.worker_id, seq)
 
     def _pool(self):
         with self._task_pool_lock:
@@ -264,6 +278,7 @@ class Worker:
                     args = task.get("args", ())
                     kwargs = task.get("kwargs", {})
                     data = self._resolve_data_refs(task.get("data_refs", ()))
+                    self._fault_task_hook()
                     t0 = time.perf_counter()
                     with trace_prop.propagated(batch_ctx):
                         with span("worker/task", worker_id=self.worker_id):
@@ -352,7 +367,16 @@ class Worker:
         _flight.record("state", "registered", worker_id=self.worker_id)
         debug_server = self._serve_debug()
         missed = 0
+        beat_index = 0
         while not self._stop_event.wait(2.0):
+            # Fault-plan hook: hb_stall silences this worker's beats so
+            # the master's liveness monitor sees a partitioned host.
+            if _fault.active() and _fault.on_heartbeat(
+                beat_index, worker=self.worker_id
+            ):
+                beat_index += 1
+                continue
+            beat_index += 1
             beat = {"worker_id": self.worker_id}
             # Refresh resource gauges (RSS, HBM, store occupancy) so the
             # delta below ships them to the master's merged view.

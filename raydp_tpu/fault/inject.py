@@ -1,0 +1,277 @@
+"""Process-local fault hooks and preemption state.
+
+All hooks are cheap no-ops unless ``RAYDP_TPU_FAULT_PLAN`` is set, so
+production paths pay one env lookup. The parsed plan is cached per
+plan string; each armed clause fires at most once per process.
+
+Preemption is a process-wide flag: both the injected ``preempt``
+clause and a real SIGTERM (via :func:`install_sigterm_drain`) set it,
+arm a grace-deadline force-exit timer, and let the training loop
+drain the in-flight step and write an emergency checkpoint before
+raising :class:`PreemptionError`. :func:`mark_drained` cancels the
+force-exit timer once the emergency checkpoint is durable.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+import time
+from typing import List, Optional
+
+from raydp_tpu.fault.plan import FAULT_PLAN_ENV, FaultClause, parse_plan
+
+PREEMPT_GRACE_ENV = "RAYDP_TPU_PREEMPT_GRACE_S"
+
+_DEFAULT_GRACE_S = 30.0
+_PREEMPT_EXIT_CODE = 143  # 128 + SIGTERM, what an undrained preemption looks like
+
+
+class PreemptionError(RuntimeError):
+    """Raised by a training loop after draining a preemption notice.
+
+    ``checkpoint_path`` is the emergency checkpoint written during the
+    drain, or ``None`` if no checkpoint directory was configured.
+    """
+
+    def __init__(self, message: str, checkpoint_path: Optional[str] = None):
+        super().__init__(message)
+        self.checkpoint_path = checkpoint_path
+
+
+class _State:
+    def __init__(self) -> None:
+        self.plan_text: Optional[str] = None
+        self.clauses: List[FaultClause] = []
+        self.rpc_counts: dict = {}
+        self.preempt = threading.Event()
+        self.drained = threading.Event()
+        self.grace_timer: Optional[threading.Timer] = None
+        self.prev_sigterm = None
+        self.sigterm_installed = False
+
+
+_lock = threading.Lock()
+_state = _State()
+
+
+def ambient_rank() -> Optional[int]:
+    """The SPMD rank of this process, if launched as a gang member."""
+    raw = os.environ.get("RAYDP_SPMD_RANK")
+    if raw is None:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        return None
+
+
+def _clauses() -> List[FaultClause]:
+    text = os.environ.get("RAYDP_TPU_FAULT_PLAN")
+    if not text:
+        return []
+    with _lock:
+        if _state.plan_text != text:
+            seed_raw = os.environ.get("RAYDP_TPU_FAULT_SEED", "0")
+            try:
+                seed = int(seed_raw)
+            except ValueError:
+                seed = 0
+            _state.clauses = parse_plan(text, seed=seed)
+            _state.plan_text = text
+            _state.rpc_counts = {}
+        return _state.clauses
+
+
+def active() -> bool:
+    """True when a fault plan is configured for this process."""
+    return bool(os.environ.get("RAYDP_TPU_FAULT_PLAN"))
+
+
+def _die(clause: FaultClause, what: str) -> None:
+    print(
+        f"raydp-fault: injected kill: {what} (exit {clause.code})",
+        file=sys.stderr,
+        flush=True,
+    )
+    os._exit(clause.code)
+
+
+def on_train_step(step: int, rank: Optional[int] = None) -> None:
+    """Hook at each estimator train-step boundary.
+
+    ``step`` is 1-based (the step that just completed). ``rank``
+    defaults to the ambient SPMD rank.
+    """
+    clauses = _clauses()
+    if not clauses:
+        return
+    if rank is None:
+        rank = ambient_rank()
+    for c in clauses:
+        if not c.armed or c.fired:
+            continue
+        if c.kind == "kill" and c.step is not None and c.step == step:
+            if c.matches_rank(rank):
+                c.fired = True
+                _die(c, f"rank {rank} at train step {step}")
+        elif c.kind == "preempt" and c.step == step and c.matches_rank(rank):
+            c.fired = True
+            request_preemption(grace_s=c.grace)
+
+
+def on_task(worker_id: str, task_index: int) -> None:
+    """Hook when an ETL worker begins its ``task_index``-th task."""
+    for c in _clauses():
+        if not c.armed or c.fired:
+            continue
+        if c.kind == "kill" and c.task is not None and c.task == task_index:
+            if c.matches_worker(worker_id):
+                c.fired = True
+                _die(c, f"worker {worker_id} at task {task_index}")
+
+
+def on_rpc(qualified_method: str) -> Optional[str]:
+    """Hook before an RPC client sends ``Service.Method``.
+
+    Sleeps in place for a matching ``rpc_delay`` clause. Returns
+    ``"drop"`` when a matching ``rpc_drop`` clause fires (the caller
+    raises UNAVAILABLE instead of sending); ``None`` otherwise.
+    """
+    clauses = _clauses()
+    if not clauses:
+        return None
+    with _lock:
+        n = _state.rpc_counts.get(qualified_method, 0)
+        _state.rpc_counts[qualified_method] = n + 1
+    verdict = None
+    for c in clauses:
+        if not c.armed or c.fired or c.nth != n or not c.matches_method(qualified_method):
+            continue
+        if c.kind == "rpc_delay":
+            c.fired = True
+            time.sleep(c.delay)
+        elif c.kind == "rpc_drop":
+            c.fired = True
+            verdict = "drop"
+    return verdict
+
+
+def on_heartbeat(
+    beat_index: int, rank: Optional[int] = None, worker: Optional[str] = None
+) -> bool:
+    """Hook per heartbeat; returns True when this beat must be skipped."""
+    clauses = _clauses()
+    if not clauses:
+        return False
+    if rank is None and worker is None:
+        rank = ambient_rank()
+    for c in clauses:
+        if c.kind != "hb_stall" or not c.armed:
+            continue
+        if c.rank is not None and not c.matches_rank(rank):
+            continue
+        if c.worker is not None and not c.matches_worker(worker):
+            continue
+        if c.after <= beat_index < c.after + c.beats:
+            return True
+    return False
+
+
+def preemption_requested() -> bool:
+    """True once a preemption notice (real or injected) has landed."""
+    return _state.preempt.is_set()
+
+
+def request_preemption(grace_s: Optional[float] = None) -> None:
+    """Deliver a preemption notice to this process.
+
+    Sets the drain flag and arms a force-exit timer: if the training
+    loop has not called :func:`mark_drained` within the grace window,
+    the process hard-exits with code 143 — exactly the budgeted
+    behaviour of a real TPU preemption. ``grace_s <= 0`` disables the
+    force-exit deadline (useful for in-process tests).
+    """
+    if grace_s is None:
+        raw = os.environ.get("RAYDP_TPU_PREEMPT_GRACE_S")
+        try:
+            grace_s = float(raw) if raw else _DEFAULT_GRACE_S
+        except ValueError:
+            grace_s = _DEFAULT_GRACE_S
+    with _lock:
+        first = not _state.preempt.is_set()
+        _state.preempt.set()
+        if first and grace_s > 0:
+            def _force_exit() -> None:
+                if _state.drained.is_set():
+                    return
+                print(
+                    f"raydp-fault: preemption grace of {grace_s:.1f}s expired "
+                    "before drain; force-exiting",
+                    file=sys.stderr,
+                    flush=True,
+                )
+                os._exit(_PREEMPT_EXIT_CODE)
+
+            t = threading.Timer(grace_s, _force_exit)
+            t.daemon = True
+            t.start()
+            _state.grace_timer = t
+    if first:
+        print(
+            f"raydp-fault: preemption notice (grace {grace_s:.1f}s); "
+            "draining step and writing emergency checkpoint",
+            file=sys.stderr,
+            flush=True,
+        )
+
+
+def mark_drained() -> None:
+    """Cancel the preemption force-exit deadline; drain completed."""
+    _state.drained.set()
+    with _lock:
+        if _state.grace_timer is not None:
+            _state.grace_timer.cancel()
+            _state.grace_timer = None
+
+
+def install_sigterm_drain() -> None:
+    """Route SIGTERM into the preemption drain path.
+
+    Must run *after* any flight-recorder signal install so the drain
+    handler (checkpoint-then-exit) replaces the dump-then-die default.
+    No-op off the main thread and on platforms without SIGTERM.
+    """
+    def _handler(signum, frame):  # noqa: ANN001 - signal signature
+        request_preemption()
+
+    try:
+        with _lock:
+            if _state.sigterm_installed:
+                return
+            _state.prev_sigterm = signal.signal(signal.SIGTERM, _handler)
+            _state.sigterm_installed = True
+    except ValueError:
+        # Not the main thread; preemption notices must then be injected.
+        pass
+
+
+def reset_for_tests() -> None:
+    """Clear all process-local fault state (plan cache, preemption)."""
+    with _lock:
+        _state.plan_text = None
+        _state.clauses = []
+        _state.rpc_counts = {}
+        _state.preempt = threading.Event()
+        _state.drained = threading.Event()
+        if _state.grace_timer is not None:
+            _state.grace_timer.cancel()
+            _state.grace_timer = None
+        if _state.sigterm_installed and _state.prev_sigterm is not None:
+            try:
+                signal.signal(signal.SIGTERM, _state.prev_sigterm)
+            except ValueError:
+                pass
+        _state.sigterm_installed = False
+        _state.prev_sigterm = None

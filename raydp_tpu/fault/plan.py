@@ -1,0 +1,191 @@
+"""Fault-plan grammar and parser.
+
+A plan is a semicolon-separated list of clauses read from
+``RAYDP_TPU_FAULT_PLAN``::
+
+    clause  ::= kind ":" key "=" value ("," key "=" value)*
+    plan    ::= clause (";" clause)*
+
+Kinds and their keys (see ``doc/fault_tolerance.md`` for semantics):
+
+``kill``
+    ``rank=N,step=K[,code=C]`` — SPMD rank ``N`` hard-exits with code
+    ``C`` (default 23) when its estimator reaches train step ``K``; or
+    ``worker=ID,task=K[,code=C]`` — ETL worker ``ID`` hard-exits when
+    it starts its ``K``-th task (0-based).
+``preempt``
+    ``step=K[,rank=N][,grace=S]`` — deliver a preemption notice at
+    train step ``K`` (all ranks unless ``rank`` is given; injected
+    slice preemption takes the whole gang, matching TPU semantics).
+    ``grace`` overrides ``RAYDP_TPU_PREEMPT_GRACE_S`` for the
+    force-exit deadline.
+``rpc_delay``
+    ``method=M,nth=K,delay=S`` — the ``K``-th (0-based) client call of
+    RPC method ``M`` (bare or ``Service.Method``) sleeps ``S`` seconds
+    before sending.
+``rpc_drop``
+    ``method=M,nth=K`` — the ``K``-th client call of method ``M``
+    raises an UNAVAILABLE error instead of being sent.
+``hb_stall``
+    ``rank=N,beats=B[,after=K]`` (or ``worker=ID``) — the heartbeat
+    loop of that process skips ``B`` consecutive beats starting at
+    beat ``K`` (default 0), simulating a network partition long enough
+    to trip liveness timeouts.
+
+Any clause may carry ``prob=P`` (0..1): whether it arms is decided
+once, deterministically, from ``RAYDP_TPU_FAULT_SEED`` and the clause
+index — so a seeded chaos sweep is reproducible run-to-run. Each
+armed clause fires at most once per process.
+"""
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+FAULT_PLAN_ENV = "RAYDP_TPU_FAULT_PLAN"
+FAULT_SEED_ENV = "RAYDP_TPU_FAULT_SEED"
+
+_KINDS = ("kill", "preempt", "rpc_delay", "rpc_drop", "hb_stall")
+
+_REQUIRED: Dict[str, tuple] = {
+    "rpc_delay": ("method", "nth", "delay"),
+    "rpc_drop": ("method", "nth"),
+    "hb_stall": ("beats",),
+}
+
+_ALLOWED: Dict[str, tuple] = {
+    "kill": ("rank", "step", "worker", "task", "code", "prob"),
+    "preempt": ("step", "rank", "grace", "prob"),
+    "rpc_delay": ("method", "nth", "delay", "prob"),
+    "rpc_drop": ("method", "nth", "prob"),
+    "hb_stall": ("rank", "worker", "beats", "after", "prob"),
+}
+
+_INT_KEYS = ("rank", "step", "task", "code", "nth", "beats", "after")
+_FLOAT_KEYS = ("delay", "grace", "prob")
+
+
+class FaultPlanError(ValueError):
+    """Raised for a malformed ``RAYDP_TPU_FAULT_PLAN`` value."""
+
+
+@dataclass
+class FaultClause:
+    """One parsed clause of the fault plan."""
+
+    kind: str
+    rank: Optional[int] = None
+    worker: Optional[str] = None
+    step: Optional[int] = None
+    task: Optional[int] = None
+    code: int = 23
+    method: Optional[str] = None
+    nth: Optional[int] = None
+    delay: float = 0.0
+    grace: Optional[float] = None
+    beats: int = 0
+    after: int = 0
+    prob: float = 1.0
+    armed: bool = True
+    fired: bool = field(default=False, compare=False)
+
+    def matches_rank(self, rank: Optional[int]) -> bool:
+        return self.rank is None or (rank is not None and rank == self.rank)
+
+    def matches_worker(self, worker: Optional[str]) -> bool:
+        return self.worker is None or (worker is not None and worker == self.worker)
+
+    def matches_method(self, qualified: str) -> bool:
+        if self.method is None:
+            return False
+        if self.method == qualified:
+            return True
+        # Bare method name matches any service ("Ping" ~ "Master.Ping").
+        return "." not in self.method and qualified.rsplit(".", 1)[-1] == self.method
+
+
+def _coerce(kind: str, key: str, raw: str):
+    try:
+        if key in _INT_KEYS:
+            return int(raw)
+        if key in _FLOAT_KEYS:
+            return float(raw)
+    except ValueError:
+        raise FaultPlanError(
+            f"fault plan: clause {kind!r}: key {key}={raw!r} is not numeric"
+        ) from None
+    return raw
+
+
+def parse_plan(text: str, seed: int = 0) -> List[FaultClause]:
+    """Parse a plan string into armed clauses.
+
+    ``seed`` feeds the deterministic ``prob`` coin flips; the clause
+    index is mixed in so each clause gets an independent decision.
+    """
+    clauses: List[FaultClause] = []
+    for idx, part in enumerate(p.strip() for p in text.split(";")):
+        if not part:
+            continue
+        kind, sep, body = part.partition(":")
+        kind = kind.strip()
+        if kind not in _KINDS:
+            raise FaultPlanError(
+                f"fault plan: unknown kind {kind!r} (expected one of {_KINDS})"
+            )
+        if not sep or not body.strip():
+            raise FaultPlanError(f"fault plan: clause {kind!r} has no arguments")
+        kwargs: Dict[str, object] = {}
+        for item in body.split(","):
+            key, eq, raw = item.partition("=")
+            key = key.strip()
+            raw = raw.strip()
+            if not eq or not key or not raw:
+                raise FaultPlanError(
+                    f"fault plan: clause {kind!r}: bad key=value item {item.strip()!r}"
+                )
+            if key not in _ALLOWED[kind]:
+                raise FaultPlanError(
+                    f"fault plan: clause {kind!r} does not accept key {key!r} "
+                    f"(allowed: {_ALLOWED[kind]})"
+                )
+            if key in kwargs:
+                raise FaultPlanError(
+                    f"fault plan: clause {kind!r}: duplicate key {key!r}"
+                )
+            kwargs[key] = _coerce(kind, key, raw)
+        for req in _REQUIRED.get(kind, ()):
+            if req not in kwargs:
+                raise FaultPlanError(
+                    f"fault plan: clause {kind!r} requires key {req!r}"
+                )
+        if kind == "kill":
+            if ("step" in kwargs) == ("task" in kwargs):
+                raise FaultPlanError(
+                    "fault plan: kill clause needs exactly one of step= (train "
+                    "rank) or task= (ETL worker)"
+                )
+            if "step" in kwargs and "rank" not in kwargs:
+                raise FaultPlanError("fault plan: kill step= clause needs rank=")
+            if "task" in kwargs and "worker" not in kwargs:
+                raise FaultPlanError("fault plan: kill task= clause needs worker=")
+        if kind == "preempt" and "step" not in kwargs:
+            raise FaultPlanError("fault plan: preempt clause requires key 'step'")
+        if kind == "hb_stall" and "rank" not in kwargs and "worker" not in kwargs:
+            raise FaultPlanError(
+                "fault plan: hb_stall clause needs rank= or worker="
+            )
+        clause = FaultClause(kind=kind, **kwargs)  # type: ignore[arg-type]
+        if not 0.0 <= clause.prob <= 1.0:
+            raise FaultPlanError(
+                f"fault plan: clause {kind!r}: prob must be in [0, 1]"
+            )
+        if clause.prob < 1.0:
+            # str seed: hashlib-based, stable across processes and
+            # PYTHONHASHSEED (tuple seeding is hash-based + deprecated)
+            clause.armed = (
+                random.Random(f"{seed}:{idx}").random() < clause.prob
+            )
+        clauses.append(clause)
+    return clauses

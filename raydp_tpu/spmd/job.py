@@ -172,6 +172,10 @@ class SPMDJob:
         self._lock = threading.Lock()
         self._started = False
         self._failed: Optional[str] = None
+        # Ranks that registered in the most recent start() attempt —
+        # survives the stop() cleanup so a supervisor can size an
+        # elastic relaunch to the hosts that actually showed up.
+        self.last_registered: Optional[int] = None
         self._gen = 0  # incarnation counter scoping watcher threads
         self._stopping = False
         self._log_paths: List[str] = []
@@ -293,6 +297,7 @@ class SPMDJob:
             )
         for rank, addr in self._worker_addrs.items():
             self._stubs[rank] = RpcClient(addr, WORKER_SERVICE, timeout=None)
+        self.last_registered = len(self._worker_addrs)
         self._started = True
         return self
 
@@ -325,6 +330,7 @@ class SPMDJob:
             if alive and now < start_t + hard:
                 continue  # slow but alive: cold imports on a loaded host
             tails = self._log_tails()
+            self.last_registered = got
             self.stop()
             raise SPMDJobError(
                 f"job {self.job_name}: only {got}/{self.world_size} ranks "

@@ -24,6 +24,7 @@ from typing import Optional
 
 import cloudpickle
 
+from raydp_tpu import fault as _fault
 from raydp_tpu.cluster.rpc import RpcClient, RpcServer
 from raydp_tpu.spmd.job import (
     DRIVER_SERVICE,
@@ -223,7 +224,18 @@ class SPMDWorker:
         )
 
         install_compile_listener()
+        beat_index = 0
         while not self._stop_event.wait(5.0):
+            # Fault-plan hook: an hb_stall clause silences this rank's
+            # beats without touching the socket — the driver-side
+            # liveness view sees exactly what a partitioned host
+            # produces: nothing.
+            if _fault.active() and _fault.on_heartbeat(
+                beat_index, rank=self.rank
+            ):
+                beat_index += 1
+                continue
+            beat_index += 1
             beat = {"rank": self.rank}
             # HBM used/peak + host RSS for this rank, refreshed per beat.
             try:
@@ -318,6 +330,11 @@ def main() -> int:
     # Health plane: crash/SIGTERM postmortem bundles, trace-stamped
     # JSONL logs, progress watchdog.
     _flight.install(component="spmd-worker")
+    # After the flight recorder's SIGTERM dump handler: a preemption
+    # notice must drain the step and write an emergency checkpoint, not
+    # dump-and-die. The drain path still produces a postmortem bundle if
+    # the grace deadline force-exits.
+    _fault.install_sigterm_drain()
     _logs.install()
     _watchdog.ensure_started()
     atexit.register(flush_spans)

@@ -262,6 +262,27 @@ def render_prometheus(view: Dict[str, Any]) -> str:
         "raydp_compile_failures_total", "counter",
         "XLA compiles that raised (remote-compile HTTP errors included).",
     )
+    restarts = _Family(
+        "raydp_restarts_total", "counter",
+        "Supervised fit_spmd gang relaunches (rank death, registration "
+        "timeout, or preemption; see doc/fault_tolerance.md).",
+    )
+    preemptions = _Family(
+        "raydp_preemptions_total", "counter",
+        "Preemption notices observed by the fit_spmd supervisor (drained "
+        "with an emergency checkpoint when checkpoint_dir is set).",
+    )
+    replay_steps = _Family(
+        "raydp_replay_steps_total", "counter",
+        "Optimizer steps re-executed after recovery: steps the dead "
+        "incarnation ran past the checkpoint it resumed from (advisory, "
+        "heartbeat-lag accuracy; bounded by save_every_steps).",
+    )
+    worker_restarts = _Family(
+        "raydp_worker_restarts_total", "counter",
+        "ETL worker respawns by the cluster elastic loop, labelled by "
+        "the worker that crashed (per-lineage sliding-window budget).",
+    )
     host_rss = _Family(
         "raydp_host_rss_bytes", "gauge",
         "Host resident-set size per process (kind=current|peak; peak is "
@@ -390,6 +411,23 @@ def render_prometheus(view: Dict[str, Any]) -> str:
                             section[name],
                         )
                         continue
+                    if name == "restarts/total":
+                        restarts.add({"worker": worker_id}, section[name])
+                        continue
+                    if name == "preemptions/total":
+                        preemptions.add({"worker": worker_id}, section[name])
+                        continue
+                    if name == "replay/steps":
+                        replay_steps.add({"worker": worker_id}, section[name])
+                        continue
+                    if name.startswith("worker_restarts/"):
+                        # The label is the CRASHED worker; the series
+                        # source is the supervising driver process.
+                        worker_restarts.add(
+                            {"worker": name[len("worker_restarts/"):]},
+                            section[name],
+                        )
+                        continue
                     if name == "compile/count":
                         compiles.add({"worker": worker_id}, section[name])
                         continue
@@ -482,7 +520,9 @@ def render_prometheus(view: Dict[str, Any]) -> str:
                    stalls, rpc_payload, shuffle_bytes, shuffle_local,
                    shuffles_elided, pipeline_overlap, stage_rows,
                    stage_bytes, stage_seconds,
-                   compiles, compile_seconds, compile_failures, host_rss,
+                   compiles, compile_seconds, compile_failures,
+                   restarts, preemptions, replay_steps, worker_restarts,
+                   host_rss,
                    hbm_bytes, store_occupancy, mfu, anomalies, step_hist,
                    generic_hist, gauges):
         lines.extend(family.render())

@@ -13,6 +13,7 @@ class, no process groups, no allreduce hooks).
 from __future__ import annotations
 
 import logging
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
@@ -24,6 +25,7 @@ import optax
 from flax.training.train_state import TrainState
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from raydp_tpu import fault as _fault
 from raydp_tpu.data.ml_dataset import MLDataset
 from raydp_tpu.parallel.mesh import MeshSpec
 from raydp_tpu.telemetry import event as _event
@@ -46,6 +48,11 @@ def _guard_compile(jitted: Callable, label: str) -> Callable:
     step, how long the compile ran, or what the service said. Later
     calls pass through untouched — runtime errors are not compile
     errors and must not be relabelled as such.
+
+    Retryable failures (``CompileError.retryable``: the remote compile
+    service itself fell over with a 5xx) are re-dispatched up to
+    ``RAYDP_TPU_COMPILE_RETRIES`` times (default 1) before surfacing —
+    a crashed compile helper should cost one retry, not the job.
     """
     state = {"first": True}
 
@@ -54,13 +61,32 @@ def _guard_compile(jitted: Callable, label: str) -> Callable:
             return jitted(*args, **kwargs)
         from raydp_tpu.utils.profiling import enrich_compile_error
 
-        start = time.monotonic()
         try:
-            out = jitted(*args, **kwargs)
-        except Exception as exc:
-            raise enrich_compile_error(
-                exc, time.monotonic() - start, label
-            ) from exc
+            retries = max(
+                0, int(os.environ.get("RAYDP_TPU_COMPILE_RETRIES", "1"))
+            )
+        except ValueError:
+            retries = 1
+        attempt = 0
+        while True:
+            start = time.monotonic()
+            try:
+                out = jitted(*args, **kwargs)
+                break
+            except Exception as exc:
+                enriched = enrich_compile_error(
+                    exc, time.monotonic() - start, label
+                )
+                if getattr(enriched, "retryable", False) and attempt < retries:
+                    attempt += 1
+                    logger.warning(
+                        "compile of %r failed with a retryable service "
+                        "error (HTTP %s); retry %d/%d",
+                        label, getattr(enriched, "http_status", "?"),
+                        attempt, retries,
+                    )
+                    continue
+                raise enriched from exc
         state["first"] = False
         # First dispatch is also the cost-analysis moment: register
         # analytical FLOPs/bytes for the MFU/roofline gauges. lower()
@@ -259,6 +285,9 @@ class JAXEstimator:
         self._state: Optional[TrainState] = None
         self._state_shardings = None
         self._resume_position = None
+        # World size the restored checkpoint was written under (elastic
+        # resize rescales the resume position by saved/current world).
+        self._resume_world = None
         self._train_step = None
         self._eval_step = None
         self._predict_step = None
@@ -558,11 +587,58 @@ class JAXEstimator:
         for cb in self.callbacks:
             cb.on_epoch_end(epoch, metrics)
         if self.checkpoint_dir:
-            self.save(self.checkpoint_dir, step=epoch)
+            # Epoch-end checkpoints carry their data position too: a
+            # supervisor resuming from one continues at the next epoch's
+            # first batch instead of replaying finished epochs.
+            self.save(
+                self.checkpoint_dir, step=epoch,
+                data_position=(epoch + 1, 0),
+            )
         # Epoch boundary = natural flush point for the span ring buffer
         # (no-op unless RAYDP_TPU_TELEMETRY_DIR is configured).
         flush_spans()
         return metrics
+
+    def _drain_preemption(
+        self, steps_done: int, epoch: int, b_idx: int
+    ) -> None:
+        """Preemption notice landed: write an emergency checkpoint and
+        surface :class:`raydp_tpu.fault.PreemptionError`.
+
+        Runs at a step boundary, so the state saved is a completed
+        optimizer step and the recorded data position is exact. All
+        ranks must reach this together (orbax save barriers) — real
+        single-host preemptions in a multi-host gang rely on the grace
+        force-exit deadline instead, and the supervisor resumes the
+        survivors from the last periodic checkpoint.
+        """
+        path = None
+        if self.checkpoint_dir:
+            path = self.save(
+                self.checkpoint_dir,
+                step=f"emergency_{steps_done}",
+                data_position=(epoch, b_idx),
+            )
+            logger.warning(
+                "preemption drain: emergency checkpoint at %s "
+                "(step %d, epoch %d, batch %d)",
+                path, steps_done, epoch, b_idx,
+            )
+        else:
+            logger.warning(
+                "preemption drain: no checkpoint_dir configured; "
+                "exiting without an emergency checkpoint"
+            )
+        _flight.record("train", "preempt_drain", step=steps_done,
+                       epoch=epoch, batch=b_idx,
+                       **({"path": path} if path else {}))
+        flush_spans()
+        _fault.mark_drained()
+        raise _fault.PreemptionError(
+            f"preempted at step {steps_done} (epoch {epoch}, batch "
+            f"{b_idx}); emergency checkpoint: {path or 'none'}",
+            checkpoint_path=path,
+        )
 
     # -- training -------------------------------------------------------
     def fit(
@@ -643,6 +719,23 @@ class JAXEstimator:
             self.restore_path(resume_from, sample_x=sample_x)
             if self._resume_position is not None:
                 start_epoch, skip_batches = self._resume_position
+                # Elastic resize: the checkpoint's batch index is
+                # per-rank under the world size that WROTE it. On a
+                # different world size the same global position lands at
+                # a different per-rank index — rescale by saved/current
+                # (rounding costs at most one batch of replay, bounded
+                # and documented in doc/fault_tolerance.md).
+                saved_world = self._resume_world
+                cur_world = _data_world()
+                if saved_world and saved_world != cur_world:
+                    skip_batches = int(
+                        round(skip_batches * saved_world / cur_world)
+                    )
+                    logger.info(
+                        "elastic resume: world %d -> %d, per-rank skip "
+                        "rescaled to %d batches",
+                        saved_world, cur_world, skip_batches,
+                    )
             # Fast-forward the dropout rng chain: one split per completed
             # optimizer step, exactly as the uninterrupted run consumed it.
             for _ in range(int(self._state.step)):
@@ -765,6 +858,15 @@ class JAXEstimator:
                             step=f"mid_{steps_done}",
                             data_position=(epoch, b_idx),
                         )
+                    # Fault plane: injected kills/preemptions fire at
+                    # this exact step boundary, and a preemption notice
+                    # (injected or real SIGTERM) drains here — after the
+                    # optimizer step and any scheduled save, so the
+                    # emergency checkpoint is consistent.
+                    if _fault.active():
+                        _fault.on_train_step(steps_done)
+                    if _fault.preemption_requested():
+                        self._drain_preemption(steps_done, epoch, b_idx)
                     if self.log_every and n_batches % self.log_every == 0:
                         logger.info(
                             "epoch %d step %d loss %.5f",
@@ -1246,6 +1348,9 @@ class JAXEstimator:
                 "step": jax.device_get(self._state.step),
                 "data_epoch": np.asarray(epoch, dtype=np.int64),
                 "data_batch": np.asarray(batch, dtype=np.int64),
+                # World size that wrote this checkpoint: elastic resume
+                # onto a different world rescales the data position.
+                "data_world": np.asarray(_data_world(), dtype=np.int64),
             },
             force=True,
         )
@@ -1279,14 +1384,19 @@ class JAXEstimator:
             "step": jax.device_get(self._state.step),
             "data_epoch": np.asarray(0, dtype=np.int64),
             "data_batch": np.asarray(0, dtype=np.int64),
+            "data_world": np.asarray(0, dtype=np.int64),
         }
         ckptr = ocp.StandardCheckpointer()
         # Legacy checkpoints (pre data-position) lack the data_epoch/
-        # data_batch keys. Detect by inspecting the checkpoint's own tree
-        # metadata rather than retry-on-failure, so a genuinely corrupt
-        # checkpoint surfaces its real error instead of a misleading
-        # missing-key one (ADVICE r2).
+        # data_batch keys, and pre-elastic ones lack data_world. Detect
+        # by inspecting the checkpoint's own tree metadata rather than
+        # retry-on-failure, so a genuinely corrupt checkpoint surfaces
+        # its real error instead of a misleading missing-key one
+        # (ADVICE r2).
         has_position = _ckpt_has_keys(path, ("data_epoch", "data_batch"))
+        has_world = _ckpt_has_keys(path, ("data_world",))
+        if has_world is False:
+            skeleton.pop("data_world")
         if has_position is False:
             skeleton.pop("data_epoch")
             skeleton.pop("data_batch")
@@ -1302,10 +1412,13 @@ class JAXEstimator:
             except Exception:
                 skeleton.pop("data_epoch")
                 skeleton.pop("data_batch")
+                skeleton.pop("data_world", None)
                 restored = ckptr.restore(path, skeleton)
         epoch = int(restored.get("data_epoch", -1))
         batch = int(restored.get("data_batch", -1))
         self._resume_position = (epoch, batch) if epoch >= 0 else None
+        saved_world = int(restored.get("data_world", 0))
+        self._resume_world = saved_world if saved_world > 0 else None
         state = TrainState.create(
             apply_fn=self._model.apply,
             params=restored["params"],
@@ -1359,6 +1472,13 @@ def _is_module(obj) -> bool:
     import flax.linen as nn
 
     return isinstance(obj, nn.Module)
+
+
+def _data_world() -> int:
+    """World size recorded into checkpoints and compared on resume
+    (indirection so tests can simulate a foreign world size without
+    patching ``jax.process_count`` out from under orbax)."""
+    return jax.process_count()
 
 
 def _ckpt_path(checkpoint_dir: str, step: Optional[int]):

@@ -328,19 +328,56 @@ def install_compile_listener() -> bool:
 _REMOTE_COMPILE_RE = None
 
 
+class CompileError(RuntimeError):
+    """Structured XLA compile failure.
+
+    Carries everything a supervisor or retry loop needs to decide what
+    to do, instead of a bare string: ``label`` (which jitted step),
+    ``duration_s`` (how long the compile ran), ``endpoint`` /
+    ``http_status`` (set for remote-compile failures), ``xla_detail``
+    (whatever compiler diagnostics the original text contained), and
+    ``retryable`` — True only for remote-compile HTTP 5xx, where the
+    compile *service* failed (helper OOM-killed, subprocess crash) and
+    an identical request can succeed; a 4xx or a local compiler
+    diagnostic is deterministic and retrying it just burns time.
+    """
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        label: str,
+        duration_s: float,
+        endpoint: Optional[str] = None,
+        http_status: Optional[int] = None,
+        xla_detail: str = "",
+        retryable: bool = False,
+    ):
+        super().__init__(message)
+        self.label = label
+        self.duration_s = duration_s
+        self.endpoint = endpoint
+        self.http_status = http_status
+        self.xla_detail = xla_detail
+        self.retryable = retryable
+
+
 def enrich_compile_error(
     exc: BaseException, duration_s: float, label: str
-) -> RuntimeError:
-    """Build an actionable error for a failed XLA compile.
+) -> "CompileError":
+    """Build an actionable, structured error for a failed XLA compile.
 
     Remote-compile failures surface as an opaque
     ``INTERNAL: http://...:PORT/remote_compile: HTTP 500:
     tpu_compile_helper subprocess exit code N`` with none of the
-    compiler's own diagnostics. Wrap them (and any other compile-time
-    failure) with the compile duration, the phase label, and every line
-    of XLA/compiler detail present in the original text, so the log
-    carries what the HTTP 500 swallowed. Chain with ``raise ... from
-    exc`` at the call site to keep the original traceback."""
+    compiler's own diagnostics (BENCH_r04/r05: the seq-16384 dense-
+    attention path). Wrap them (and any other compile-time failure) in
+    a :class:`CompileError` carrying the compile duration, the phase
+    label, the endpoint/status, and every line of XLA/compiler detail
+    present in the original text — with ``retryable`` set for 5xx
+    service failures so callers can re-dispatch once instead of dying.
+    Chain with ``raise ... from exc`` at the call site to keep the
+    original traceback."""
     global _REMOTE_COMPILE_RE
     if _REMOTE_COMPILE_RE is None:
         import re
@@ -354,9 +391,18 @@ def enrich_compile_error(
         f"XLA compilation failed in {label!r} after {duration_s:.1f}s"
         f" ({type(exc).__name__})."
     ]
+    endpoint: Optional[str] = None
+    http_status: Optional[int] = None
+    detail = ""
+    retryable = False
     m = _REMOTE_COMPILE_RE.search(text)
     if m:
         endpoint, status, body = m.group(1), m.group(2), m.group(3)
+        http_status = int(status)
+        # 5xx: the compile SERVICE fell over under this request (helper
+        # OOM/crash) — the identical request can succeed on a retry.
+        # 4xx means the request itself was rejected; deterministic.
+        retryable = 500 <= http_status < 600
         lines.append(
             f"The compile was served remotely by {endpoint} which"
             f" returned HTTP {status} — the compiler error below is"
@@ -370,9 +416,24 @@ def enrich_compile_error(
             " per-stage program or use flash attention), or the helper"
             " OOM-killed; retry with a smaller shape to confirm."
         )
+        if retryable:
+            lines.append(
+                "This failure class is transient "
+                "(CompileError.retryable=True); RAYDP_TPU_COMPILE_RETRIES "
+                "controls automatic re-dispatch."
+            )
     else:
-        lines.append(f"Compiler said: {text.strip() or '(empty message)'}")
-    err = RuntimeError("\n".join(lines))
+        detail = text.strip()
+        lines.append(f"Compiler said: {detail or '(empty message)'}")
+    err = CompileError(
+        "\n".join(lines),
+        label=label,
+        duration_s=duration_s,
+        endpoint=endpoint,
+        http_status=http_status,
+        xla_detail=detail,
+        retryable=retryable,
+    )
     metrics.counter_add("compile/failures")
     metrics.counter_add("compile/seconds", duration_s)
     return err

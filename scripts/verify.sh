@@ -82,6 +82,72 @@ assert "== Physical Plan ==" in text and "skew" in text, text
 PYEOF
   rm -rf "$smoke_dir"
 fi
+# Chaos smoke (HARD): a tiny supervised fit with an injected rank kill
+# must auto-recover (exactly one restart, resume from the mid-step
+# checkpoint) and land on the SAME loss as an uninterrupted run —
+# the end-to-end proof that doc/fault_tolerance.md's recovery story
+# holds, not just its unit tests.
+if [ "$rc" -eq 0 ]; then
+  echo "--- chaos smoke (injected rank kill) ---"
+  JAX_PLATFORMS=cpu python - <<'PYEOF' \
+    && echo "CHAOS_SMOKE=ok" || { echo "CHAOS_SMOKE=failed"; rc=1; }
+import os
+import tempfile
+
+import numpy as np
+import pandas as pd
+
+import raydp_tpu.dataframe as rdf
+from raydp_tpu.data import MLDataset
+from raydp_tpu.train.spmd_fit import fit_spmd
+
+
+def factory_builder(ckpt):
+    def make_estimator():
+        import jax
+        import optax
+
+        from raydp_tpu.models import MLP
+        from raydp_tpu.parallel import MeshSpec
+        from raydp_tpu.train import JAXEstimator
+
+        return JAXEstimator(
+            model=MLP(hidden=(8,), out_dim=1), optimizer=optax.adam(3e-2),
+            loss="mse", num_epochs=2, batch_size=128,
+            feature_columns=["a", "b"], label_column="y",
+            mesh=MeshSpec(dp=len(jax.devices())), seed=0, shuffle=False,
+            epoch_mode="stream", checkpoint_dir=ckpt, save_every_steps=2,
+        )
+
+    return make_estimator
+
+
+rng = np.random.default_rng(0)
+a, b = rng.standard_normal(512), rng.standard_normal(512)
+pdf = pd.DataFrame({"a": a, "b": b, "y": 2 * a - 3 * b + 1})
+ds = MLDataset.from_df(rdf.from_pandas(pdf, num_partitions=2), num_shards=1)
+root = tempfile.mkdtemp()
+clean = fit_spmd(
+    factory_builder(os.path.join(root, "clean")), ds, world_size=1,
+    env={"JAX_PLATFORMS": "cpu"}, timeout=300,
+)
+chaos_ck = os.path.join(root, "chaos")
+chaos = fit_spmd(
+    factory_builder(chaos_ck), ds, world_size=1,
+    env={
+        "JAX_PLATFORMS": "cpu",
+        "RAYDP_TPU_FAULT_PLAN": "kill:rank=0,step=2",
+    },
+    timeout=300, checkpoint_dir=chaos_ck,
+)
+assert chaos["restarts"] == 1, f"expected 1 restart, got {chaos['restarts']}"
+assert os.path.isdir(os.path.join(chaos_ck, "step_mid_2")), "no mid ckpt"
+np.testing.assert_allclose(
+    chaos["history"][-1]["train_loss"],
+    clean["history"][-1]["train_loss"], rtol=1e-4,
+)
+PYEOF
+fi
 # Bench regression gate (ADVISORY): when two result files exist, diff
 # the newest pair; a >10% throughput/MFU regression prints loudly but
 # never fails the tier-1 gate (bench noise on shared CI boxes is real

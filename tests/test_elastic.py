@@ -98,3 +98,107 @@ def test_map_partitions_completes_despite_worker_kill():
         assert out["x"].tolist() == (pdf["x"] * 3).tolist()
     finally:
         raydp_tpu.stop()
+
+
+def test_worker_restart_budget_is_per_lineage():
+    """The restart budget is per worker LINEAGE (sliding window,
+    doc/fault_tolerance.md): a crash-looping worker exhausts its own
+    budget and stays down, while an unrelated worker that crashes later
+    still gets its full budget — the old global counter starved it."""
+    s = raydp_tpu.init(
+        app_name="elastic-lineage", num_workers=2, max_worker_restarts=1
+    )
+    try:
+        first = sorted(w.worker_id for w in s.cluster.alive_workers())
+        victim, other = first[0], first[1]
+        s.cluster._procs[victim].kill()
+        assert _wait(
+            lambda: len(s.cluster.alive_workers()) == 2
+            and victim
+            not in {w.worker_id for w in s.cluster.alive_workers()}
+        ), "first crash was not respawned"
+        replacement = [
+            w.worker_id
+            for w in s.cluster.alive_workers()
+            if w.worker_id not in first
+        ][0]
+        # the respawn inherits its predecessor's spent budget
+        s.cluster._procs[replacement].kill()
+        assert _wait(lambda: len(s.cluster.alive_workers()) == 1, timeout=8)
+        time.sleep(1.5)  # no respawn sneaks in afterwards
+        assert len(s.cluster.alive_workers()) == 1
+        # ...but the OTHER lineage still has its own full budget
+        s.cluster._procs[other].kill()
+        assert _wait(
+            lambda: len(s.cluster.alive_workers()) == 1
+            and other
+            not in {w.worker_id for w in s.cluster.alive_workers()}
+        ), "healthy lineage was starved by the exhausted one"
+
+        from raydp_tpu.utils.profiling import metrics as _metrics
+
+        counters = _metrics.snapshot().get("counters", {})
+        assert counters.get(f"worker_restarts/{victim}", 0) >= 1
+        assert counters.get(f"worker_restarts/{other}", 0) >= 1
+    finally:
+        raydp_tpu.stop()
+
+
+def test_worker_restart_window_expires(monkeypatch):
+    """Restarts age out of the sliding window: with a 1s window a
+    lineage can keep recovering from occasional crashes forever, it is
+    only a crash LOOP (faster than the window) that exhausts it."""
+    monkeypatch.setenv("RAYDP_TPU_RESTART_WINDOW_S", "1.0")
+    s = raydp_tpu.init(
+        app_name="elastic-window", num_workers=1, max_worker_restarts=1
+    )
+    try:
+        for _ in range(2):
+            current = {w.worker_id for w in s.cluster.alive_workers()}
+            victim = sorted(current)[0]
+            s.cluster._procs[victim].kill()
+            assert _wait(
+                lambda: len(s.cluster.alive_workers()) == 1
+                and victim
+                not in {w.worker_id for w in s.cluster.alive_workers()}
+            ), "crash within budget was not respawned"
+            time.sleep(1.2)  # previous restart ages out of the window
+    finally:
+        raydp_tpu.stop()
+
+
+def test_mldataset_shard_resolution_survives_producer_kill():
+    """An MLDataset whose producing stage is still running loses a
+    worker mid-epoch: holder-owned inputs + task re-run deliver every
+    row to the training loaders anyway (the fit-side half of the
+    map_partitions kill test above)."""
+    from raydp_tpu.data import MLDataset
+
+    s = raydp_tpu.init(app_name="elastic-loader", num_workers=3)
+    try:
+        pdf = pd.DataFrame({"a": np.arange(6000, dtype=np.float64)})
+        df = rdf.from_pandas(pdf, num_partitions=6)
+
+        def slow_stage(t):
+            import time as _t
+
+            _t.sleep(0.6)
+            return t
+
+        ds = MLDataset.from_df(df.mapPartitions(slow_stage), num_shards=2)
+        result = {}
+
+        def consume():
+            tables = list(ds.shard_tables(0)) + list(ds.shard_tables(1))
+            result["rows"] = sum(t.num_rows for t in tables)
+
+        worker = threading.Thread(target=consume)
+        worker.start()
+        time.sleep(0.3)  # stage tasks are in flight
+        victim = sorted(s.cluster._procs)[0]
+        s.cluster._procs[victim].kill()
+        worker.join(timeout=90)
+        assert not worker.is_alive(), "shard resolution hung after kill"
+        assert result["rows"] == 6000
+    finally:
+        raydp_tpu.stop()

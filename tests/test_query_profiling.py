@@ -169,37 +169,61 @@ def test_stage_stats_kill_switch(zero_coalesce, monkeypatch):
 
 def test_compile_error_enrichment():
     from raydp_tpu.train.estimator import _guard_compile
+    from raydp_tpu.utils.profiling import CompileError
 
-    opaque = RuntimeError(
+    http_500 = (
         "INTERNAL: http://10.0.0.1:8471/remote_compile: HTTP 500: "
         "tpu_compile_helper subprocess exit code 137"
     )
-
     calls = {"n": 0}
 
     def step(x):
         calls["n"] += 1
         if calls["n"] == 1:
-            raise opaque
+            raise RuntimeError(http_500)
         return x + 1
 
     before = metrics.snapshot().get("counters", {}).get(
         "compile/failures", 0.0
     )
     guarded = _guard_compile(step, "train_step")
-    with pytest.raises(RuntimeError) as exc_info:
-        guarded(1)
+    # A transient 5xx from the compile SERVICE costs one automatic
+    # re-dispatch (RAYDP_TPU_COMPILE_RETRIES), not the job.
+    assert guarded(1) == 2
+    assert calls["n"] == 2
+    after = metrics.snapshot()["counters"]["compile/failures"]
+    assert after == before + 1  # the failed attempt still counts
+
+    # A PERSISTENT 5xx exhausts the retry budget and surfaces as a
+    # structured CompileError with the enrichment intact.
+    def always_500(x):
+        raise RuntimeError(http_500)
+
+    with pytest.raises(CompileError) as exc_info:
+        _guard_compile(always_500, "train_step")(1)
     msg = str(exc_info.value)
     assert "train_step" in msg
     assert "remote_compile" in msg
     assert "HTTP 500" in msg
     assert re.search(r"after \d+\.\d+s", msg)
-    assert exc_info.value.__cause__ is opaque  # original traceback kept
-    after = metrics.snapshot()["counters"]["compile/failures"]
-    assert after == before + 1
-    # Later calls pass through unguarded: successes are untouched and a
-    # post-compile runtime error is NOT relabelled as a compile failure.
-    assert guarded(1) == 2
+    assert exc_info.value.retryable is True
+    assert exc_info.value.__cause__ is not None  # original traceback kept
+
+    # 4xx means the request itself was rejected — deterministic, so it
+    # surfaces immediately without burning a retry.
+    calls_4xx = {"n": 0}
+
+    def rejected(x):
+        calls_4xx["n"] += 1
+        raise RuntimeError(
+            "INTERNAL: http://10.0.0.1:8471/remote_compile: HTTP 400: "
+            "program rejected"
+        )
+
+    with pytest.raises(CompileError) as exc_4xx:
+        _guard_compile(rejected, "train_step")(1)
+    assert calls_4xx["n"] == 1
+    assert exc_4xx.value.retryable is False
 
     def runtime_fail(x):
         if x > 1:
