@@ -1750,6 +1750,79 @@ def bench_device_plane():
     return out
 
 
+# ----------------------------------------------------------- job accounting
+
+def bench_job_accounting():
+    """Job-accounting-plane overhead evidence (doc/telemetry.md "Job
+    accounting & event timeline"): the same host-side ETL pipeline run
+    under an explicit job scope with the plane ON vs
+    ``RAYDP_TPU_JOB_ACCOUNTING=0`` — interleaved runs + medians, same
+    discipline as ``stage_stats_overhead``; budget <5%. Also stamps
+    the per-job usage rollup the ON arm produced, so ``bench_compare``
+    diffs the attribution itself, not just the latency."""
+    import pandas as pd
+
+    import raydp_tpu.dataframe as rdf
+    from raydp_tpu import telemetry
+    from raydp_tpu.dataframe import dataframe as D
+    from raydp_tpu.utils.profiling import metrics as _metrics
+
+    n_rows = 200_000
+    rs = np.random.RandomState(7)
+    pdf = pd.DataFrame({
+        "k": rs.randint(0, 512, n_rows),
+        "v": rs.rand(n_rows),
+    })
+
+    bench_job = telemetry.mint_job("bench-accounting")
+
+    def one_run():
+        df = rdf.from_pandas(pdf, num_partitions=4)
+        t0 = time.perf_counter()
+        with telemetry.job_scope(bench_job):
+            df.groupBy("k").agg({"v": "sum"}).to_pandas()
+        return time.perf_counter() - t0
+
+    # Force the real exchange path (a coalesced groupBy moves no bytes,
+    # so there would be nothing to attribute).
+    saved = (D._EXCHANGE_COALESCE_BYTES, D._AGG_COALESCE_BYTES,
+             D._COMBINE_COALESCE_BYTES)
+    D._EXCHANGE_COALESCE_BYTES = 0
+    D._AGG_COALESCE_BYTES = 0
+    D._COMBINE_COALESCE_BYTES = 0
+    ons, offs = [], []
+    try:
+        one_run()  # warm both arms' shared caches
+        for i in range(10):
+            if i % 2:
+                ons.append(one_run())
+            else:
+                os.environ["RAYDP_TPU_JOB_ACCOUNTING"] = "0"
+                offs.append(one_run())
+                os.environ.pop("RAYDP_TPU_JOB_ACCOUNTING", None)
+    finally:
+        os.environ.pop("RAYDP_TPU_JOB_ACCOUNTING", None)
+        (D._EXCHANGE_COALESCE_BYTES, D._AGG_COALESCE_BYTES,
+         D._COMBINE_COALESCE_BYTES) = saved
+    ons.sort(), offs.sort()
+    on_s, off_s = ons[len(ons) // 2], offs[len(offs) // 2]
+    out = {
+        "rows_per_sec": round(n_rows / on_s, 1),
+        "unit": "rows/s",
+        "enabled_s": round(on_s, 4),
+        "disabled_s": round(off_s, 4),
+        "overhead_frac": round(
+            (on_s - off_s) / off_s if off_s else 0.0, 4
+        ),
+        "baseline": "same pipeline with RAYDP_TPU_JOB_ACCOUNTING=0",
+    }
+    report = telemetry.usage_report({"driver": _metrics.snapshot()})
+    billed = report["jobs"].get(bench_job.job_id, {}).get("usage", {})
+    out["job_usage"] = {k: round(v, 4) for k, v in sorted(billed.items())}
+    out["jobs_seen"] = len(report["jobs"])
+    return out
+
+
 def bench_fault_tolerance():
     """Recovery-cost evidence (doc/fault_tolerance.md): the same tiny
     supervised ``fit_spmd`` run twice — clean, then with an injected
@@ -1925,6 +1998,9 @@ CPU_MATRIX = [
     ("dataplane", bench_dataplane),
     # Phase-accounting overhead + fraction evidence (host-side fit).
     ("device_plane", bench_device_plane),
+    # Job-accounting-plane overhead + per-job attribution evidence
+    # (host-side ETL under an explicit job scope).
+    ("job_accounting", bench_job_accounting),
     # Recovery cost (MTTR) of the supervised gang under an injected
     # rank kill; host-side, loss parity is the correctness gate.
     ("fault_tolerance", bench_fault_tolerance),

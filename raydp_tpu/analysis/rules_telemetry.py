@@ -14,6 +14,14 @@ none of them were machine-checked before this rule:
   must appear in the docs. → ``undocumented-family`` (error)
 * **env vars** — every ``RAYDP_TPU_*`` variable read in code must
   appear in the docs table. → ``undocumented-env`` (error)
+* **job attribution** — the ``usage/*`` and ``job/*`` counter
+  namespaces are the job accounting ledger; they are only coherent
+  when both halves (the cluster-global ``usage/<kind>`` counter and
+  the per-job ``job/<id>/<kind>`` counter) are emitted together, which
+  is exactly what ``accounting.add_usage`` does. A raw
+  ``metrics.counter_add("usage/...", ...)`` anywhere outside
+  ``telemetry/accounting.py`` bypasses the ledger and silently loses
+  the per-job attribution. → ``unattributed-metric`` (error)
 
 Name resolution follows module-level string constants (e.g.
 ``STALL_COUNTER = "watchdog/stalls"`` used as ``counter_add(STALL_COUNTER)``),
@@ -34,6 +42,11 @@ RULE = "R4"
 _EMIT_METHODS = {"counter_add", "gauge_set", "gauge_max", "histogram",
                  "timer", "meter"}
 _ENV_PREFIX = "RAYDP_TPU_"
+
+# The job accounting ledger's namespaces: raw emits into these outside
+# the accounting module lose per-job attribution (use add_usage).
+_LEDGER_PREFIXES = ("usage/", "job/")
+_LEDGER_HOME = "telemetry/accounting.py"
 
 
 def _module_constants(project: Project) -> Dict[str, str]:
@@ -156,6 +169,23 @@ def check(project: Project) -> List[Finding]:
                 node.args[0], mod, graph, consts)
             if value is None:
                 continue  # fully dynamic — out of scope
+            if _ledger_name(value) and not mod.rel.endswith(_LEDGER_HOME):
+                key = (mod.rel, value, node.lineno)
+                if key in seen_metrics:
+                    continue
+                seen_metrics.add(key)
+                findings.append(Finding(
+                    rule=RULE, name="unattributed-metric",
+                    severity="error",
+                    path=mod.rel, line=node.lineno, col=node.col_offset,
+                    message=f"raw emit of ledger metric '{value}' "
+                            f"bypasses job attribution; use "
+                            f"accounting.add_usage so the per-job "
+                            f"counter is billed alongside the "
+                            f"cluster-global one",
+                    scope="",
+                ))
+                continue
             if _routed(value, prefix_only, exact, prefixes):
                 continue
             if not prefix_only and value in docs:
@@ -211,6 +241,10 @@ def check(project: Project) -> List[Finding]:
                 scope="",
             ))
     return findings
+
+
+def _ledger_name(value: str) -> bool:
+    return any(value.startswith(p) for p in _LEDGER_PREFIXES)
 
 
 def _routed(value: str, prefix_only: bool, exact: Set[str],

@@ -270,12 +270,22 @@ class Cluster:
                         self._restarts_used += 1
                 if self.master is None:
                     return
+                from raydp_tpu.telemetry import events as _events
+
+                _events.emit(
+                    "worker/dead", worker=wid, node=node,
+                    rc=proc.returncode,
+                )
                 self.master.mark_worker_dead(
                     wid, reason=f"process exited rc={proc.returncode}"
                 )
                 if allow:
                     _metrics.counter_add(f"worker_restarts/{wid}")
                     new_id = self._spawn_worker(node_id=node)
+                    _events.emit(
+                        "worker/restart", worker=wid, respawned_as=new_id,
+                        node=node, restarts_in_window=len(history),
+                    )
                     with self._lock:
                         # Lineage carry-over: if the respawn crash-loops,
                         # it exhausts this same window, not a fresh one.
@@ -373,9 +383,16 @@ class Cluster:
         return bundle.node_id or "node-0"
 
     def _child_trace_env(self) -> Dict[str, str]:
+        from raydp_tpu.telemetry import accounting as _acct
         from raydp_tpu.telemetry import propagation as _prop
 
-        return _prop.env_for_child(self._trace_ctx)
+        # Trace + job identity travel together: a child process joins
+        # the driver's trace AND bills usage to the ambient job (empty
+        # entries when there is nothing to propagate).
+        return {
+            **_prop.env_for_child(self._trace_ctx),
+            **_acct.env_for_child(),
+        }
 
     def _spawn_worker(self, node_id: Optional[str] = None) -> str:
         seq = next(self._worker_seq)
@@ -408,6 +425,9 @@ class Cluster:
         with self._lock:
             self._procs[worker_id] = proc
             self._worker_nodes[worker_id] = node_id
+        from raydp_tpu.telemetry import events as _events
+
+        _events.emit("worker/spawn", worker=worker_id, node=node_id)
         return worker_id
 
     def shutdown(self, del_obj_holder: bool = True, fast: bool = False) -> None:
@@ -621,6 +641,26 @@ class Cluster:
         flush_spans()
         return analyze.trace_report(directory)
 
+    def usage_report(self) -> dict:
+        """Per-job usage totals folded from the merged cluster view:
+        chip-seconds, host task-seconds, shuffle/staged/fetched bytes,
+        HBM-byte-seconds, and compile-seconds, each billed to the
+        :class:`~raydp_tpu.telemetry.accounting.JobContext` in scope
+        when the work ran. The input the fair-share scheduler reads;
+        also exported as the ``raydp_job_*`` Prometheus families."""
+        from raydp_tpu.telemetry import accounting as _acct
+
+        return _acct.usage_report(self.metrics_snapshot())
+
+    def events_report(self, job: Optional[str] = None) -> dict:
+        """The cluster event timeline + MTTR report (parity with
+        :meth:`usage_report`); also served at ``/debug/events``."""
+        from raydp_tpu.telemetry import events as _events
+        from raydp_tpu.telemetry import telemetry_dir
+
+        records = _events.load_event_records(telemetry_dir(), job=job)
+        return {"events": records, "mttr": _events.mttr_report(records)}
+
     def health_report(self) -> Optional[dict]:
         """Aggregated cluster health (parity with :meth:`trace_report`):
         per-worker heartbeat age + watchdog stall flags shipped on
@@ -748,9 +788,13 @@ class Cluster:
         # SUBMITTING thread's trace context here so the worker-side task
         # span parents under e.g. the driver's df/stage span instead of
         # the bare job root.
+        from raydp_tpu.telemetry import accounting as _acct
         from raydp_tpu.telemetry import propagation as _prop
 
         trace_ctx = _prop.current_context()
+        # Same capture for the job: the RunTask envelope must bill the
+        # SUBMITTING thread's job, not whatever the pool thread holds.
+        job_ctx = _acct.current_job()
 
         def run():
             import grpc
@@ -821,7 +865,7 @@ class Cluster:
 
         def traced_run():
             try:
-                with _prop.propagated(trace_ctx):
+                with _prop.propagated(trace_ctx), _acct.job_scope(job_ctx):
                     return run()
             finally:
                 # Staged data_args are scratch: the worker has consumed
@@ -875,12 +919,14 @@ class Cluster:
         futures: List[Future] = [Future() for _ in specs]
         if not specs:
             return futures
+        from raydp_tpu.telemetry import accounting as _acct
         from raydp_tpu.telemetry import propagation as _prop
 
         trace_ctx = _prop.current_context()
+        job_ctx = _acct.current_job()
 
         def orchestrate():
-            with _prop.propagated(trace_ctx):
+            with _prop.propagated(trace_ctx), _acct.job_scope(job_ctx):
                 try:
                     self._run_batch(
                         list(specs), futures, timeout, retries, meta_sink
@@ -1083,8 +1129,14 @@ class Cluster:
         ride the control plane."""
         if not tables:
             return []
+        from raydp_tpu.telemetry import accounting as _acct
+
         store = self.master.store
-        return [store.put_arrow_table(t) for t in tables]
+        refs = [store.put_arrow_table(t) for t in tables]
+        _acct.add_usage(
+            _acct.STAGED_BYTES, sum(r.size for r in refs)
+        )
+        return refs
 
     def _discard_staged(self, refs: Sequence) -> None:
         if not refs or self.master is None:

@@ -13,7 +13,10 @@ trace context into the request dict as ``traceparent``
 each handler inside ``propagated(ctx)``, so spans recorded on handler
 threads parent under the caller's span. The key is left in the request
 — handlers that defer work to another thread (the SPMD runner queue)
-forward it themselves.
+forward it themselves. Job attribution rides the same way: a ``job``
+entry (:mod:`raydp_tpu.telemetry.accounting`) is injected next to the
+traceparent and the handler runs inside ``job_scope``, so usage a
+worker emits on a caller's behalf bills to the caller's job.
 
 The health plane rides here too: every client call is bracketed as an
 in-flight ``rpc`` op (a peer that never answers shows up in the
@@ -33,6 +36,7 @@ import cloudpickle
 import grpc
 
 from raydp_tpu import fault as _fault
+from raydp_tpu.telemetry import accounting as _acct
 from raydp_tpu.telemetry import flight_recorder as _flight
 from raydp_tpu.telemetry import propagation as _prop
 from raydp_tpu.telemetry import watchdog as _watchdog
@@ -134,6 +138,15 @@ class RpcServer:
                     if ctx is not None
                     else contextlib.nullcontext()
                 )
+                # Job attribution rides the same envelope: usage the
+                # handler emits (task seconds, bytes) bills to the
+                # caller's job, not the worker's own identity.
+                jctx = _acct.extract(request)
+                job_scope = (
+                    _acct.job_scope(jctx)
+                    if jctx is not None
+                    else contextlib.nullcontext()
+                )
                 # A deadlocked handler is attributed by the watchdog as
                 # "rpc/handler" with the method name. Methods that run
                 # user code (a whole task body / shipped function) are
@@ -144,7 +157,7 @@ class RpcServer:
                     _watchdog.long_stall_s()
                     if method in _LONG_HANDLER_METHODS else None
                 )
-                with scope, _watchdog.inflight(
+                with scope, job_scope, _watchdog.inflight(
                     "rpc/handler", method=method, stall_after_s=stall_s
                 ):
                     reply = fn(request)
@@ -220,7 +233,9 @@ class RpcClient:
                 else _watchdog.long_stall_s()
             ),
         )
-        request_bytes = cloudpickle.dumps(_prop.inject(request or {}))
+        request_bytes = cloudpickle.dumps(
+            _acct.inject(_prop.inject(request or {}))
+        )
         # Control-plane envelope size. Data is supposed to move through
         # the shm object store, so a fat counter here means some path is
         # smuggling table bytes through RPC (exported as

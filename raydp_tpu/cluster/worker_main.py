@@ -28,6 +28,7 @@ from raydp_tpu import fault as _fault
 from raydp_tpu.cluster.rpc import RpcClient, RpcServer
 from raydp_tpu.store.object_store import ObjectStore
 from raydp_tpu.telemetry import MetricsShipper, flush_spans, span
+from raydp_tpu.telemetry import accounting as _acct
 from raydp_tpu.telemetry import flight_recorder as _flight
 from raydp_tpu.telemetry import logs as _logs
 from raydp_tpu.telemetry import propagation as trace_prop
@@ -213,10 +214,14 @@ class Worker:
                     with metrics.timer("worker/task").time():
                         result = fn(self.ctx, *args, *data, **kwargs)
             _flight.record("task", "end", worker_id=self.worker_id)
+            exec_s = time.perf_counter() - t0
+            # RpcServer._wrap installed the caller's job scope, so
+            # host-CPU task seconds bill to the job that submitted the
+            # task, not to this worker's own identity.
+            _acct.add_usage(_acct.TASK_SECONDS, exec_s)
             # exec_s lets the driver split stage wall into queue vs
             # execution (stage-stats attribution) with no extra RPC.
-            return {"result": result,
-                    "exec_s": time.perf_counter() - t0}
+            return {"result": result, "exec_s": exec_s}
         except Exception:
             # Let RpcServer._wrap serialize the failure uniformly.
             raise
@@ -269,8 +274,10 @@ class Worker:
                            tasks=len(tasks))
             # Task-pool threads don't inherit this handler thread's
             # propagated traceparent — re-propagate it so per-task spans
-            # still parent under the driver's stage span.
+            # still parent under the driver's stage span. The job scope
+            # crosses the same thread boundary the same way.
             batch_ctx = trace_prop.current_context()
+            batch_job = _acct.current_job()
 
             def run_one(task: dict) -> dict:
                 try:
@@ -280,12 +287,14 @@ class Worker:
                     data = self._resolve_data_refs(task.get("data_refs", ()))
                     self._fault_task_hook()
                     t0 = time.perf_counter()
-                    with trace_prop.propagated(batch_ctx):
+                    with trace_prop.propagated(batch_ctx), \
+                            _acct.job_scope(batch_job):
                         with span("worker/task", worker_id=self.worker_id):
                             with metrics.timer("worker/task").time():
                                 value = fn(self.ctx, *args, *data, **kwargs)
-                    return {"ok": True, "value": value,
-                            "exec_s": time.perf_counter() - t0}
+                        exec_s = time.perf_counter() - t0
+                        _acct.add_usage(_acct.TASK_SECONDS, exec_s)
+                    return {"ok": True, "value": value, "exec_s": exec_s}
                 except Exception as exc:
                     return {
                         "ok": False,
@@ -487,8 +496,11 @@ def main(argv=None) -> int:
     )
     # Join the driver's job trace (RAYDP_TPU_TRACEPARENT in our launch
     # env) before any span is recorded; flush tail spans on interpreter
-    # exit so clean shutdowns never lose the last buffer.
+    # exit so clean shutdowns never lose the last buffer. The job
+    # identity (RAYDP_TPU_JOB) is adopted the same way, so usage this
+    # process emits outside any RPC scope still bills correctly.
     trace_prop.adopt_env_context()
+    _acct.adopt_env_job()
     # Health plane: black box (crash/SIGTERM postmortem bundles),
     # trace-stamped JSONL logs, and the progress watchdog.
     _flight.install(component="worker")

@@ -35,6 +35,7 @@ from raydp_tpu.dataframe.scheduler import (
     streaming_enabled,
 )
 from raydp_tpu.store.object_store import ObjectRef, ObjectStore
+from raydp_tpu.telemetry import accounting as _acct
 from raydp_tpu.telemetry import span
 from raydp_tpu.telemetry.progress import (
     StageStats,
@@ -47,12 +48,22 @@ from raydp_tpu.utils.profiling import metrics
 StageFn = Callable[[pa.Table], pa.Table]
 
 
+def _ensure_etl_job() -> None:
+    """Workload-root job attribution for bare pipelines: the first
+    executed stage in a process with no ambient JobContext mints one
+    process-default ``etl`` job. Explicit user ``job_scope``s (and SPMD
+    jobs, which install their own) take precedence via current_job()."""
+    if _acct.current_job() is None:
+        _acct.set_process_job(_acct.mint_job("etl"))
+
+
 def _stage_span(op: str, n_parts: int, executor: str, **attrs):
     """Span + counter around one stage execution (driver side: covers
     submit AND result gather on the cluster backend, so the duration is
     the stage's wall time as the query planner experiences it). Under
     streaming dispatch the span covers scheduling only — completion
     happens on callback threads and the true wall lands in StageStats."""
+    _ensure_etl_job()
     metrics.counter_add("df/stages")
     return span("df/stage", op=op, parts=n_parts, executor=executor, **attrs)
 
@@ -530,6 +541,7 @@ class LocalExecutor(Executor):
             metrics.counter_add("shuffle/bytes", moved)
             # Single host: every chunk is already local to its merge.
             metrics.counter_add("shuffle/local_bytes", moved)
+            _acct.add_usage(_acct.SHUFFLE_BYTES, moved)
             outs = []
             for i in range(n_out):
                 merged = _concat([chunks[i] for chunks in chunked])
@@ -916,6 +928,7 @@ class ClusterExecutor(Executor):
                 merge_inputs.append(refs)
             metrics.counter_add("shuffle/bytes", total_b)
             metrics.counter_add("shuffle/local_bytes", local_b)
+            _acct.add_usage(_acct.SHUFFLE_BYTES, total_b)
             merge_futures = self.cluster.submit_batch(
                 specs, meta_sink=rec.task_meta
             )

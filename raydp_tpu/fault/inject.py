@@ -89,12 +89,25 @@ def active() -> bool:
     return bool(os.environ.get("RAYDP_TPU_FAULT_PLAN"))
 
 
+def _emit_clause(clause: FaultClause, what: str) -> None:
+    """Timeline record of a clause firing — the injected cause lands in
+    /debug/events next to the gang churn it produces. Write-through
+    makes it durable even when the clause kills this process."""
+    try:
+        from raydp_tpu.telemetry import events as _events
+
+        _events.emit("fault/clause", kind=clause.kind, what=what)
+    except Exception:
+        pass
+
+
 def _die(clause: FaultClause, what: str) -> None:
     print(
         f"raydp-fault: injected kill: {what} (exit {clause.code})",
         file=sys.stderr,
         flush=True,
     )
+    _emit_clause(clause, what)
     os._exit(clause.code)
 
 
@@ -118,6 +131,7 @@ def on_train_step(step: int, rank: Optional[int] = None) -> None:
                 _die(c, f"rank {rank} at train step {step}")
         elif c.kind == "preempt" and c.step == step and c.matches_rank(rank):
             c.fired = True
+            _emit_clause(c, f"rank {rank} preempted at train step {step}")
             request_preemption(grace_s=c.grace)
 
 
@@ -151,9 +165,11 @@ def on_rpc(qualified_method: str) -> Optional[str]:
             continue
         if c.kind == "rpc_delay":
             c.fired = True
+            _emit_clause(c, f"delayed {qualified_method} by {c.delay}s")
             time.sleep(c.delay)
         elif c.kind == "rpc_drop":
             c.fired = True
+            _emit_clause(c, f"dropped {qualified_method}")
             verdict = "drop"
     return verdict
 

@@ -35,6 +35,8 @@ import cloudpickle
 
 from raydp_tpu.cluster.rpc import RpcClient, RpcServer
 from raydp_tpu.telemetry import ClusterTelemetry, span
+from raydp_tpu.telemetry import accounting as _acct
+from raydp_tpu.telemetry import events as _events
 from raydp_tpu.telemetry import flight_recorder as _flight
 from raydp_tpu.telemetry import watchdog as _watchdog
 from raydp_tpu.utils.net import find_free_port
@@ -181,6 +183,8 @@ class SPMDJob:
         self._log_paths: List[str] = []
         self._trace_ctx = None
         self._owns_trace_ctx = False
+        self._job_ctx: Optional[_acct.JobContext] = None
+        self._owns_job_ctx = False
         # Per-rank metrics merged from heartbeat-shipped deltas; survives
         # gang restarts (ranks keep their keys across incarnations).
         self.telemetry = ClusterTelemetry()
@@ -189,6 +193,10 @@ class SPMDJob:
         # never contend with dispatch bookkeeping.
         self._health_lock = threading.Lock()
         self._rank_health: Dict[str, dict] = {}
+        # Monotonic timestamp of each rank's last Ping — health_report()
+        # ages ranks out against it (late / dead vocabulary shared with
+        # Cluster.health_report).
+        self._rank_beats: Dict[str, float] = {}
 
     def rank_nodes(self) -> List[str]:
         """Node (host) of every rank — ranks fill hosts in order,
@@ -250,6 +258,17 @@ class SPMDJob:
                 "spmd/job", job=self.job_name, world_size=self.world_size
             )
             trace_prop.set_process_context(self._trace_ctx)
+        # Job identity, same reuse-or-mint shape: a gang launched under
+        # an ambient JobContext (fit_spmd, a cluster pipeline) bills its
+        # chip-seconds there; a standalone gang is its own accounting
+        # root. Ranks inherit it via RAYDP_TPU_JOB below.
+        self._job_ctx = _acct.current_job()
+        self._owns_job_ctx = self._job_ctx is None
+        if self._job_ctx is None:
+            self._job_ctx = _acct.mint_job(
+                self.job_name, world_size=self.world_size
+            )
+            _acct.set_process_job(self._job_ctx)
 
         log_dir = os.path.join(
             "/tmp/raydp_tpu", "spmd", f"{self.job_name}-{os.getpid()}"
@@ -268,6 +287,7 @@ class SPMDJob:
                     ENV_COORDINATOR: coordinator,
                     ENV_PROCS_PER_NODE: str(self.num_procs_per_node),
                     **trace_prop.env_for_child(self._trace_ctx),
+                    **_acct.env_for_child(self._job_ctx),
                 }
             )
             cmd = prefix + [sys.executable, "-m", "raydp_tpu.spmd.worker_main"]
@@ -299,6 +319,11 @@ class SPMDJob:
             self._stubs[rank] = RpcClient(addr, WORKER_SERVICE, timeout=None)
         self.last_registered = len(self._worker_addrs)
         self._started = True
+        _events.emit(
+            "gang/launch", job=self._job_ctx, gang=self.job_name,
+            world_size=self.world_size, registered=self.last_registered,
+            gen=self._gen,
+        )
         return self
 
     def _await_registration(self) -> None:
@@ -388,12 +413,20 @@ class SPMDJob:
         restarted gang) must not poison the next one."""
         code = proc.wait()
         if code not in (0, None) and gen == self._gen and not self._stopping:
+            _events.emit(
+                "rank/dead", job=self._job_ctx, gang=self.job_name,
+                rank=rank, rc=code, gen=gen,
+            )
             self._fail(f"rank {rank} exited with code {code}")
 
     def _fail(self, reason: str) -> None:
         self._failed = reason
         _flight.record("error", "spmd_fail", job=self.job_name,
                        reason=str(reason)[:200])
+        _events.emit(
+            "gang/failed", job=self._job_ctx, gang=self.job_name,
+            reason=str(reason)[:200],
+        )
         logger.warning("SPMD job %s failed: %s", self.job_name, reason)
         self._register_barrier.set()  # wake a start() still waiting
         inflight = self._inflight
@@ -432,6 +465,7 @@ class SPMDJob:
             self._rank_health[rank_key] = (
                 (req.get("health") or {}).get("stalls") or {}
             )
+            self._rank_beats[rank_key] = time.monotonic()
         return {"pong": True, "gen": self._gen}
 
     def metrics_snapshot(self) -> dict:
@@ -541,18 +575,59 @@ class SPMDJob:
             },
         }
 
+    def usage_report(self) -> dict:
+        """Per-job usage folded from the gang's heartbeat-shipped
+        counters (chip-seconds, task-seconds, bytes moved, …) — the SPMD
+        face of :func:`raydp_tpu.telemetry.accounting.usage_report`."""
+        return _acct.usage_report(self.telemetry.merged())
+
+    # Beats arrive every ~5 s (spmd/worker_main._heartbeat); a rank quiet
+    # for half this window is late, for the whole window dead — the
+    # vocabulary of Cluster.health_report's heartbeat ageing.
+    PING_TIMEOUT_S = 30.0
+
     def health_report(self) -> dict:
         """Gang health: per-rank stall flags shipped on Pings, plus job
-        failure state (parity with ``Cluster.health_report``)."""
+        failure state (parity with ``Cluster.health_report``).
+
+        Ranks are aged against their last Ping: silent for half
+        ``PING_TIMEOUT_S`` → late, for all of it → dead. Ranks whose
+        index falls outside the current world size (an elastic restart
+        shrank the gang) are *departed* — reported as such, never
+        lingering as healthy members of a gang they left."""
+        now = time.monotonic()
         with self._health_lock:  # Pings insert keys concurrently
             snapshot = dict(self._rank_health)
-        ranks = {rid: dict(stalls) for rid, stalls in
-                 sorted(snapshot.items())}
+            beats = dict(self._rank_beats)
+        ranks: Dict[str, dict] = {}
+        departed: List[str] = []
+        for rid in sorted(snapshot):
+            try:
+                idx = int(rid.rsplit("-", 1)[1])
+            except (IndexError, ValueError):
+                idx = -1
+            if 0 <= idx < self.world_size:
+                ranks[rid] = dict(snapshot[rid])
+            else:
+                departed.append(rid)
         stalled = sorted(rid for rid, stalls in ranks.items() if stalls)
+        # A rank that never beat yet (gang just launched) ages from now.
+        dead = sorted(
+            rid for rid in ranks
+            if now - beats.get(rid, now) > self.PING_TIMEOUT_S
+        )
+        late = sorted(
+            rid for rid in ranks
+            if rid not in dead
+            and now - beats.get(rid, now) > self.PING_TIMEOUT_S / 2
+        )
         return {
-            "healthy": not stalled and not self._failed,
+            "healthy": not (stalled or dead or late) and not self._failed,
             "ranks": ranks,
             "stalled_ranks": stalled,
+            "dead_ranks": dead,
+            "late_ranks": late,
+            "departed_ranks": departed,
             "failed": self._failed,
             "world_size": self.world_size,
         }
@@ -656,6 +731,11 @@ class SPMDJob:
         """Stop workers, reap processes; the job can be start()ed again
         (reference: MPIJob.stop/_reset, mpi/mpi_job.py:341-398)."""
         self._stopping = True
+        if self._started:
+            _events.emit(
+                "gang/teardown", job=self._job_ctx, gang=self.job_name,
+                world_size=self.world_size, gen=self._gen,
+            )
         for stub in self._stubs.values():
             try:
                 stub.call("Stop", {}, timeout=2.0)
@@ -685,6 +765,11 @@ class SPMDJob:
                 trace_prop.set_process_context(None)
         self._trace_ctx = None
         self._owns_trace_ctx = False
+        if self._owns_job_ctx and self._job_ctx is not None:
+            if _acct.process_job() == self._job_ctx:
+                _acct.set_process_job(None)
+        self._job_ctx = None
+        self._owns_job_ctx = False
 
     def __enter__(self) -> "SPMDJob":
         if not self._started:

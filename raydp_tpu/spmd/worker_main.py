@@ -19,6 +19,7 @@ import os
 import queue
 import sys
 import threading
+import time
 import traceback
 from typing import Optional
 
@@ -37,6 +38,7 @@ from raydp_tpu.spmd.job import (
     WORKER_SERVICE,
 )
 from raydp_tpu.telemetry import MetricsShipper, flush_spans, span
+from raydp_tpu.telemetry import accounting as _acct
 from raydp_tpu.telemetry import flight_recorder as _flight
 from raydp_tpu.telemetry import logs as _logs
 from raydp_tpu.telemetry import propagation as trace_prop
@@ -160,6 +162,15 @@ class SPMDWorker:
                 if ctx is not None
                 else contextlib.nullcontext()
             )
+            # The driver's job rides the queued request the same way —
+            # usage the function emits bills to the submitting job even
+            # when it differs from this gang's env-adopted default.
+            jctx = _acct.extract(item)
+            job_scope = (
+                _acct.job_scope(jctx)
+                if jctx is not None
+                else contextlib.nullcontext()
+            )
             _flight.record("func", "start", rank=self.rank,
                            func_id=func_id)
             # A wedged shipped function (collective waiting on a dead
@@ -167,7 +178,7 @@ class SPMDWorker:
             # long-op threshold: a shipped function is often a whole
             # training loop, and healthy minutes-long runs must not
             # read as stalls.
-            with scope, _watchdog.inflight(
+            with scope, job_scope, _watchdog.inflight(
                 "spmd/func", rank=self.rank, func_id=func_id,
                 stall_after_s=_watchdog.long_stall_s(),
             ), span(
@@ -220,11 +231,13 @@ class SPMDWorker:
         # counters ride the same metric deltas as the step timers.
         from raydp_tpu.utils.profiling import (
             install_compile_listener,
+            metrics,
             sample_resource_gauges,
         )
 
         install_compile_listener()
         beat_index = 0
+        last_mono = time.monotonic()
         while not self._stop_event.wait(5.0):
             # Fault-plan hook: an hb_stall clause silences this rank's
             # beats without touching the socket — the driver-side
@@ -242,6 +255,17 @@ class SPMDWorker:
                 sample_resource_gauges()
             except Exception:
                 pass
+            # HBM-byte-seconds: the occupancy gauge is a point sample;
+            # integrating gauge × dt at beat cadence turns it into a
+            # meterable quantity the job ledger can bill (memory held,
+            # not just memory touched).
+            now_mono = time.monotonic()
+            hbm = metrics.gauge_value("hbm/used_bytes")
+            if hbm:
+                _acct.add_usage(
+                    _acct.HBM_BYTE_SECONDS, hbm * (now_mono - last_mono)
+                )
+            last_mono = now_mono
             delta = shipper.delta()
             if delta:
                 beat["metrics"] = delta
@@ -324,9 +348,11 @@ def main() -> int:
         level=logging.INFO,
         format=f"[spmd-{os.environ.get(ENV_RANK, '?')}] %(levelname)s %(message)s",
     )
-    # Join the driver's job trace before any span is recorded; flush
-    # tail spans on interpreter exit.
+    # Join the driver's job trace before any span is recorded, and its
+    # job identity before any usage is billed; flush tail spans on
+    # interpreter exit.
     trace_prop.adopt_env_context()
+    _acct.adopt_env_job()
     # Health plane: crash/SIGTERM postmortem bundles, trace-stamped
     # JSONL logs, progress watchdog.
     _flight.install(component="spmd-worker")

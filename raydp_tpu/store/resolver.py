@@ -16,6 +16,7 @@ from typing import Callable, Dict, Optional, Tuple
 import pyarrow as pa
 
 from raydp_tpu.store.object_store import ObjectRef, ObjectStore
+from raydp_tpu.telemetry import accounting as _acct
 from raydp_tpu.utils.profiling import metrics as _metrics
 
 # meta_fn(object_id) -> (ref, agent) where agent = {"address","service"}|None
@@ -107,6 +108,7 @@ class ObjectResolver:
         first = reply["data"]
         _metrics.counter_add("store/remote_fetch_bytes", total)
         _metrics.counter_add("store/remote_fetches")
+        _acct.add_usage(_acct.FETCHED_BYTES, total)
         if len(first) >= total:
             return first
         out = bytearray(total)

@@ -42,6 +42,16 @@ without pulling in jax):
   trace (``Cluster.capture_profile()`` / ``/debug/profile``), and
   NaN / step-regression anomaly sentinels.
 
+* :mod:`~raydp_tpu.telemetry.accounting` /
+  :mod:`~raydp_tpu.telemetry.events` — the job accounting plane: a
+  :class:`JobContext` minted at workload roots and propagated like the
+  traceparent, a usage ledger (chip-seconds, task-seconds, bytes
+  moved) billed per job via :func:`add_usage` and exported as
+  ``raydp_job_*`` families / ``usage_report()``, and a cluster event
+  timeline (worker churn, gang lifecycle, preemption, checkpoints,
+  sentinel trips) served at ``/debug/events`` and merged into the
+  Perfetto trace (``python -m raydp_tpu.telemetry.events <dir>``).
+
 Drivers pull the live aggregate with ``Cluster.metrics_snapshot()``
 (works identically through ``raydp_tpu.connect`` client sessions).
 See ``doc/telemetry.md``.
@@ -62,11 +72,30 @@ from raydp_tpu.telemetry.export import (
     write_events,
 )
 from raydp_tpu.telemetry import (
+    accounting,
     device_profiler,
+    events,
     flight_recorder,
     logs,
     progress,
     watchdog,
+)
+from raydp_tpu.telemetry.accounting import (
+    JOB_ENV,
+    JobContext,
+    add_usage,
+    adopt_env_job,
+    current_job,
+    ensure_job,
+    job_scope,
+    mint_job,
+    set_process_job,
+    usage_report,
+)
+from raydp_tpu.telemetry.events import (
+    EVENT_BUFFER_ENV,
+    load_event_records,
+    mttr_report,
 )
 from raydp_tpu.telemetry.device_profiler import (
     AnomalySentinel,
@@ -122,10 +151,25 @@ __all__ = [
     "DEBUG_PORT_ENV",
     "POSTMORTEM_DIR_ENV",
     "TRACEPARENT_ENV",
+    "JOB_ENV",
+    "EVENT_BUFFER_ENV",
     "flight_recorder",
     "logs",
     "watchdog",
     "device_profiler",
+    "accounting",
+    "events",
+    "JobContext",
+    "current_job",
+    "job_scope",
+    "mint_job",
+    "ensure_job",
+    "set_process_job",
+    "adopt_env_job",
+    "add_usage",
+    "usage_report",
+    "load_event_records",
+    "mttr_report",
     "AnomalySentinel",
     "StepPhaseAccumulator",
     "capture_trace_archive",

@@ -214,6 +214,42 @@ def _device_plane(
     return plane
 
 
+def _job_rollup(
+    records: List[Dict[str, Any]], offsets: Dict[int, float]
+) -> Dict[str, Dict[str, Any]]:
+    """Per-job rollup over job-attributed records (the event timeline's
+    ``events-*.jsonl`` shards carry a top-level ``job`` id). For each
+    job: event count, distinct processes, wall extent on the aligned
+    timeline, and a per-kind event histogram."""
+    jobs: Dict[str, Dict[str, Any]] = {}
+    for rec in records:
+        job = rec.get("job")
+        if not job:
+            continue
+        start, end = aligned_interval(rec, offsets)
+        entry = jobs.setdefault(str(job), {
+            "name": rec.get("job_name", ""),
+            "events": 0,
+            "pids": set(),
+            "first_s": start,
+            "last_s": end,
+            "by_kind": {},
+        })
+        entry["events"] += 1
+        entry["pids"].add(int(rec.get("pid", 0)))
+        entry["first_s"] = min(entry["first_s"], start)
+        entry["last_s"] = max(entry["last_s"], end)
+        if rec.get("job_name") and not entry["name"]:
+            entry["name"] = rec["job_name"]
+        kind = rec.get("name", "?")
+        entry["by_kind"][kind] = entry["by_kind"].get(kind, 0) + 1
+    for entry in jobs.values():
+        entry["processes"] = len(entry.pop("pids"))
+        entry["wall_s"] = round(entry["last_s"] - entry["first_s"], 6)
+        del entry["first_s"], entry["last_s"]
+    return jobs
+
+
 def analyze_records(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     offsets = clock_offsets(records)
     labels = process_labels(records)
@@ -236,6 +272,8 @@ def analyze_records(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         # All records, not just the dominant trace: a standalone fit's
         # phase events may carry their own trace id.
         "device_plane": _device_plane(records, labels),
+        # Likewise all records: each job's timeline is its own trace.
+        "jobs": _job_rollup(records, offsets),
     }
 
 
@@ -356,6 +394,25 @@ def format_report(report: Dict[str, Any]) -> str:
                 f" {entry['collective_frac'] * 100:>5.1f}%"
                 f"  {entry['bound']}{extra}"
             )
+    jobs = report.get("jobs") or {}
+    if jobs:
+        lines += ["", "jobs (event timeline):"]
+        for job_id in sorted(jobs):
+            entry = jobs[job_id]
+            label = job_id if not entry["name"] else (
+                f"{job_id} ({entry['name']})"
+            )
+            kinds = sorted(
+                entry["by_kind"].items(), key=lambda kv: -kv[1]
+            )
+            kind_str = " ".join(f"{k}×{n}" for k, n in kinds[:6])
+            lines.append(
+                f"  {label:<32} {entry['events']:>4} events"
+                f" · {entry['processes']} proc"
+                f" · {entry['wall_s']:.3f}s span"
+            )
+            if kind_str:
+                lines.append(f"    {kind_str}")
     stage = report.get("stage_stats")
     if stage:
         lines += [

@@ -34,11 +34,16 @@ __all__ = [
 
 
 def load_span_records(directory: str) -> List[Dict[str, Any]]:
-    """All span records under ``directory`` (``spans*.jsonl`` shards),
-    sorted by aligned start time. Malformed lines (a shard whose writer
-    died mid-append) are skipped, not fatal."""
+    """All span records under ``directory`` (``spans*.jsonl`` shards,
+    plus the cluster event timeline's ``events-*.jsonl`` shards — event
+    records are span-shaped, so they merge into the same Perfetto
+    timeline as instants), sorted by aligned start time. Malformed
+    lines (a shard whose writer died mid-append) are skipped, not
+    fatal."""
     records: List[Dict[str, Any]] = []
-    for path in sorted(glob.glob(os.path.join(directory, "spans*.jsonl"))):
+    shards = sorted(glob.glob(os.path.join(directory, "spans*.jsonl")))
+    shards += sorted(glob.glob(os.path.join(directory, "events-*.jsonl")))
+    for path in shards:
         with open(path, "r", encoding="utf-8") as f:
             for line in f:
                 line = line.strip()
@@ -142,6 +147,8 @@ def to_chrome_trace(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             "status": rec.get("status", "ok"),
             **(rec.get("attrs") or {}),
         }
+        if rec.get("job"):  # event-timeline records carry attribution
+            args["job"] = rec["job"]
         if rec.get("kind") == "event":
             events.append({
                 "name": rec.get("name", "?"),

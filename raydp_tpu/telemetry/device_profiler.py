@@ -380,6 +380,12 @@ class AnomalySentinel:
         from raydp_tpu.telemetry import flight_recorder as _flight
 
         _flight.record("anomaly", kind, **attrs)
+        try:  # timeline correlation (lazy: events imports this module)
+            from raydp_tpu.telemetry import events as _events
+
+            _events.emit("sentinel/anomaly", kind=kind, **attrs)
+        except Exception:
+            pass
         if bundle:
             try:
                 _flight.dump_bundle(f"anomaly:{kind}")

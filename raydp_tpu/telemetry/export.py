@@ -37,6 +37,7 @@ host never collide on ``RAYDP_TPU_METRICS_PORT``.
 """
 from __future__ import annotations
 
+import glob as _glob
 import json
 import logging
 import os
@@ -50,8 +51,12 @@ __all__ = [
     "TELEMETRY_DIR_ENV",
     "METRICS_PORT_ENV",
     "DEBUG_PORT_ENV",
+    "SHARD_KEEP_ENV",
     "telemetry_dir",
     "append_jsonl",
+    "shard_keep",
+    "prune_shards",
+    "prune_shards_once",
     "flush_spans",
     "write_events",
     "render_prometheus",
@@ -63,6 +68,11 @@ METRICS_PORT_ENV = "RAYDP_TPU_METRICS_PORT"
 # Worker processes serve their own /healthz + /debug endpoints on this
 # port when set. Use 0 for an ephemeral port (many workers per host).
 DEBUG_PORT_ENV = "RAYDP_TPU_DEBUG_PORT"
+# Per-kind retention cap for JSONL shards (spans-/logs-/stats-/events-);
+# oldest shards beyond the cap are pruned on a process's first write of
+# that kind, mirroring the RAYDP_TPU_POSTMORTEM_KEEP bundle cap.
+SHARD_KEEP_ENV = "RAYDP_TPU_SHARD_KEEP"
+_DEFAULT_SHARD_KEEP = 64
 
 logger = logging.getLogger(__name__)
 
@@ -87,6 +97,74 @@ def append_jsonl(path: str, records: Iterable[Dict[str, Any]]) -> int:
     return count
 
 
+# -- shard retention ----------------------------------------------------
+
+# Kinds already pruned by this process: retention runs once per
+# (directory, kind) per process — at the first write — not per append.
+_pruned_kinds: set = set()
+_prune_mu = threading.Lock()
+
+
+def shard_keep() -> int:
+    """Retention cap per shard kind (``RAYDP_TPU_SHARD_KEEP``)."""
+    try:
+        return max(1, int(os.environ.get(SHARD_KEEP_ENV, "")))
+    except ValueError:
+        return _DEFAULT_SHARD_KEEP
+
+
+def _shard_age_key(path: str) -> tuple:
+    # mtime first; the numeric <pid> breaks same-mtime ties so
+    # "oldest" stays well-defined on coarse-mtime filesystems.
+    name = os.path.basename(path)
+    try:
+        pid = int(name.rsplit("-", 1)[1].split(".", 1)[0])
+    except (IndexError, ValueError):
+        pid = 0
+    try:
+        mtime = os.path.getmtime(path)
+    except OSError:
+        mtime = 0.0
+    return (mtime, pid)
+
+
+def prune_shards(
+    directory: str, kind: str, keep: Optional[int] = None
+) -> int:
+    """Delete the oldest ``<kind>-*.jsonl`` shards beyond ``keep`` —
+    the disk bound for a telemetry dir reused across many runs.
+    Lock-free and per-file best-effort (several processes may prune one
+    shared directory concurrently). Returns the number deleted."""
+    keep = shard_keep() if keep is None else max(1, int(keep))
+    removed = 0
+    try:
+        shards = _glob.glob(os.path.join(directory, f"{kind}-*.jsonl"))
+        if len(shards) <= keep:
+            return 0
+        shards.sort(key=_shard_age_key)
+        for path in shards[:-keep]:
+            try:
+                os.unlink(path)
+                removed += 1
+            except OSError:
+                pass
+    except OSError:
+        pass
+    return removed
+
+
+def prune_shards_once(directory: str, kind: str) -> None:
+    """Run retention for ``kind`` at most once per process — writers
+    call this before their first append so a long-lived telemetry dir
+    converges to the cap without per-write listdir cost."""
+    key = (directory, kind)
+    with _prune_mu:
+        if key in _pruned_kinds:
+            return
+        _pruned_kinds.add(key)
+    prune_shards(directory, kind)
+
+
 def flush_spans(
     directory: Optional[str] = None, recorder: Optional[Any] = None
 ) -> Optional[str]:
@@ -103,6 +181,7 @@ def flush_spans(
         return None
     rec = recorder if recorder is not None else _spans.recorder
     drained = rec.drain()
+    prune_shards_once(directory, "spans")
     path = os.path.join(directory, f"spans-{os.getpid()}.jsonl")
     append_jsonl(path, (s.to_dict() for s in drained))
     return path
@@ -324,6 +403,42 @@ def render_prometheus(view: Dict[str, Any]) -> str:
         "MetricsRegistry histograms without a dedicated family, one "
         "series set per (worker, name).",
     )
+    usage_total = _Family(
+        "raydp_usage_total", "counter",
+        "Cluster-global usage-ledger totals (kind=chip_seconds|"
+        "task_seconds|shuffle_bytes|staged_bytes|fetched_bytes|"
+        "hbm_byte_seconds|compile_seconds) — the job-attributed "
+        "raydp_job_* families partition these by job.",
+    )
+    job_chip_seconds = _Family(
+        "raydp_job_chip_seconds_total", "counter",
+        "Accelerator seconds billed to a job: accumulated training-step "
+        "wall time x local device count (see accounting.add_usage).",
+    )
+    job_task_seconds = _Family(
+        "raydp_job_task_seconds_total", "counter",
+        "Host-CPU task seconds billed to a job: ETL worker task "
+        "execution time attributed via the RPC job envelope.",
+    )
+    job_bytes = _Family(
+        "raydp_job_bytes_total", "counter",
+        "Bytes moved on behalf of a job (kind=shuffle|staged|fetched).",
+    )
+    job_hbm_byte_seconds = _Family(
+        "raydp_job_hbm_byte_seconds_total", "counter",
+        "HBM residency integral billed to a job: device HBM bytes in "
+        "use integrated over wall time at heartbeat cadence.",
+    )
+    job_compile_seconds = _Family(
+        "raydp_job_compile_seconds_total", "counter",
+        "XLA compile seconds billed to a job (guarded first-dispatch "
+        "compiles plus jax.monitoring durations under a job scope).",
+    )
+    job_counter = _Family(
+        "raydp_job_counter_total", "counter",
+        "Job-attributed counters without a dedicated family, one "
+        "series per (worker, job, name).",
+    )
 
     sources: Dict[str, Dict[str, Any]] = dict(view.get("workers") or {})
     driver = view.get("driver")
@@ -428,6 +543,45 @@ def render_prometheus(view: Dict[str, Any]) -> str:
                             section[name],
                         )
                         continue
+                    if name.startswith("usage/"):
+                        usage_total.add(
+                            {"worker": worker_id,
+                             "kind": name[len("usage/"):]},
+                            section[name],
+                        )
+                        continue
+                    if name.startswith("job/"):
+                        # Per-job ledger counters: job/<job_id>/<kind>.
+                        job_id, sep, kind = (
+                            name[len("job/"):].partition("/")
+                        )
+                        if sep:
+                            labels = {"worker": worker_id, "job": job_id}
+                            if kind == "chip_seconds":
+                                job_chip_seconds.add(labels, section[name])
+                            elif kind == "task_seconds":
+                                job_task_seconds.add(labels, section[name])
+                            elif kind in ("shuffle_bytes", "staged_bytes",
+                                          "fetched_bytes"):
+                                job_bytes.add(
+                                    {**labels,
+                                     "kind": kind[:-len("_bytes")]},
+                                    section[name],
+                                )
+                            elif kind == "hbm_byte_seconds":
+                                job_hbm_byte_seconds.add(
+                                    labels, section[name]
+                                )
+                            elif kind == "compile_seconds":
+                                job_compile_seconds.add(
+                                    labels, section[name]
+                                )
+                            else:
+                                job_counter.add(
+                                    {**labels, "name": kind},
+                                    section[name],
+                                )
+                            continue
                     if name == "compile/count":
                         compiles.add({"worker": worker_id}, section[name])
                         continue
@@ -522,6 +676,9 @@ def render_prometheus(view: Dict[str, Any]) -> str:
                    stage_bytes, stage_seconds,
                    compiles, compile_seconds, compile_failures,
                    restarts, preemptions, replay_steps, worker_restarts,
+                   usage_total, job_chip_seconds, job_task_seconds,
+                   job_bytes, job_hbm_byte_seconds, job_compile_seconds,
+                   job_counter,
                    host_rss,
                    hbm_bytes, store_occupancy, mfu, anomalies, step_hist,
                    generic_hist, gauges):
@@ -583,6 +740,16 @@ def _default_progress() -> Dict[str, Any]:
     return report
 
 
+def _default_events(job: Optional[str] = None) -> Dict[str, Any]:
+    """Timeline for ``/debug/events``: every events-*.jsonl shard under
+    the telemetry dir when one is configured (so the driver endpoint
+    shows worker events too), else this process's in-memory ring."""
+    from raydp_tpu.telemetry import events as _events
+
+    records = _events.load_event_records(telemetry_dir(), job=job)
+    return {"events": records, "mttr": _events.mttr_report(records)}
+
+
 # /debug/profile capture windows: clamped so a fat-fingered
 # ?seconds=86400 can't pin a handler thread (and a jax trace buffer)
 # for a day.
@@ -611,6 +778,7 @@ def serve_prometheus(
     health: Optional[Callable[[], Dict[str, Any]]] = None,
     progress: Optional[Callable[[], Dict[str, Any]]] = None,
     profile: Optional[Callable[[float], Dict[str, Any]]] = None,
+    events: Optional[Callable[[Optional[str]], Dict[str, Any]]] = None,
 ) -> _ScrapeServer:
     """Serve the process debug surface on a daemon thread.
 
@@ -629,7 +797,10 @@ def serve_prometheus(
     device trace: ``profile(seconds)`` — default a single-process
     jax.profiler capture; the driver endpoint passes the
     gang-coordinated ``Cluster.capture_profile``; blocks the request
-    for the capture window, other routes stay responsive).
+    for the capture window, other routes stay responsive), and
+    ``/debug/events?job=ID`` (the cluster event timeline + MTTR report
+    from ``events()`` — default: every events shard under the
+    telemetry dir, else the local ring).
     Stdlib ``http.server`` only: one scrape every few seconds, no need
     for more. ``port=0`` binds an ephemeral port. Returns a handle with
     ``.port`` and idempotent ``.close()``."""
@@ -638,6 +809,7 @@ def serve_prometheus(
     health_fn = health if health is not None else _default_health
     progress_fn = progress if progress is not None else _default_progress
     profile_fn = profile if profile is not None else _default_profile
+    events_fn = events if events is not None else _default_events
 
     class Handler(BaseHTTPRequestHandler):
         def _reply(self, code: int, body: bytes, ctype: str) -> None:
@@ -693,6 +865,15 @@ def serve_prometheus(
                         ).encode("utf-8"),
                         "application/json",
                     )
+                elif path == "/debug/events":
+                    job = (query.get("job") or [None])[0]
+                    self._reply(
+                        200,
+                        json.dumps(
+                            events_fn(job), default=str
+                        ).encode("utf-8"),
+                        "application/json",
+                    )
                 elif path == "/debug/profile":
                     try:
                         seconds = float(query.get("seconds", ["3"])[0])
@@ -741,7 +922,7 @@ def serve_prometheus(
     logger.info(
         "telemetry debug endpoint on %s:%d "
         "(/metrics /livez /healthz /debug/state /debug/stacks "
-        "/debug/progress /debug/profile)",
+        "/debug/progress /debug/profile /debug/events)",
         host, server.port,
     )
     return server

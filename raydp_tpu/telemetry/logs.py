@@ -96,6 +96,9 @@ def install(directory: Optional[str] = None,
     with _mu:
         if _handler is not None:
             return _handler
+        from raydp_tpu.telemetry.export import prune_shards_once
+
+        prune_shards_once(directory, "logs")
         path = os.path.join(directory, f"logs-{os.getpid()}.jsonl")
         handler = JsonLogHandler(path)
         handler.setLevel(level)

@@ -190,7 +190,10 @@ class StageStatsStore:
             if self._shard_path is None or not self._shard_path.startswith(
                 directory
             ):
+                from raydp_tpu.telemetry.export import prune_shards_once
+
                 os.makedirs(directory, exist_ok=True)
+                prune_shards_once(directory, "stats")
                 self._shard_path = os.path.join(
                     directory, f"stats-{os.getpid()}.jsonl"
                 )

@@ -252,6 +252,15 @@ class Watchdog:
                 age_s=round(info["age_s"], 3), tid=info["tid"],
                 **info["attrs"],
             )
+            try:  # timeline correlation; never let telemetry stall us
+                from raydp_tpu.telemetry import events as _events
+
+                _events.emit(
+                    "sentinel/stall", component=component,
+                    age_s=round(info["age_s"], 3),
+                )
+            except Exception:
+                pass
             last = self._last_bundle.get(component)
             if self.dump_bundles and (
                 last is None or mono - last >= self.bundle_cooldown_s

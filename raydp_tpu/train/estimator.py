@@ -28,7 +28,9 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from raydp_tpu import fault as _fault
 from raydp_tpu.data.ml_dataset import MLDataset
 from raydp_tpu.parallel.mesh import MeshSpec
+from raydp_tpu.telemetry import accounting as _acct
 from raydp_tpu.telemetry import event as _event
+from raydp_tpu.telemetry import events as _events
 from raydp_tpu.telemetry import flush_spans, span
 from raydp_tpu.telemetry import device_profiler as _devplane
 from raydp_tpu.telemetry import flight_recorder as _flight
@@ -72,10 +74,26 @@ def _guard_compile(jitted: Callable, label: str) -> Callable:
             start = time.monotonic()
             try:
                 out = jitted(*args, **kwargs)
+                # First dispatch ≈ trace + backend compile: bill it to
+                # the job ledger so usage_report shows compile cost per
+                # job, not just per process.
+                _acct.add_usage(
+                    _acct.COMPILE_SECONDS, time.monotonic() - start
+                )
                 break
             except Exception as exc:
+                try:
+                    payload = sum(
+                        getattr(leaf, "nbytes", 0) or 0
+                        for leaf in jax.tree_util.tree_leaves(
+                            (args, kwargs)
+                        )
+                    )
+                except Exception:
+                    payload = None
                 enriched = enrich_compile_error(
-                    exc, time.monotonic() - start, label
+                    exc, time.monotonic() - start, label,
+                    payload_bytes=payload,
                 )
                 if getattr(enriched, "retryable", False) and attempt < retries:
                     attempt += 1
@@ -560,6 +578,12 @@ class JAXEstimator:
         _m.counter_add("train/epochs")
         _m.meter("train/samples").add(n_samples)
         _m.timer("train/epoch").observe(dt)
+        # Chip-seconds: this process held its local devices for the
+        # whole epoch wall time; summed across ranks on the driver the
+        # ledger reads in gang chip-seconds.
+        _acct.add_usage(
+            _acct.CHIP_SECONDS, dt * max(1, jax.local_device_count())
+        )
         metrics: Dict[str, float] = {
             "epoch": epoch,
             "train_loss": train_loss,
@@ -612,12 +636,19 @@ class JAXEstimator:
         force-exit deadline instead, and the supervisor resumes the
         survivors from the last periodic checkpoint.
         """
+        _events.emit(
+            "preempt/drain", step=steps_done, epoch=epoch, batch=b_idx,
+        )
         path = None
         if self.checkpoint_dir:
             path = self.save(
                 self.checkpoint_dir,
                 step=f"emergency_{steps_done}",
                 data_position=(epoch, b_idx),
+            )
+            _events.emit(
+                "checkpoint/emergency", path=path, step=steps_done,
+                epoch=epoch, batch=b_idx,
             )
             logger.warning(
                 "preemption drain: emergency checkpoint at %s "
@@ -1355,6 +1386,7 @@ class JAXEstimator:
             force=True,
         )
         ckptr.wait_until_finished()
+        _events.emit("checkpoint/complete", path=str(path), step=str(step))
         return str(path)
 
     def restore(self, checkpoint_dir: str, step=None,

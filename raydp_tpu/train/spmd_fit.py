@@ -150,6 +150,8 @@ def fit_spmd(
     from raydp_tpu.spmd import create_spmd_job
     from raydp_tpu.spmd.job import SPMDJobError
     from raydp_tpu.store.object_store import ObjectRef
+    from raydp_tpu.telemetry import accounting as _acct
+    from raydp_tpu.telemetry import events as _events
     from raydp_tpu.telemetry import flight_recorder as _flight
     from raydp_tpu.utils.profiling import metrics as _metrics
 
@@ -208,6 +210,13 @@ def fit_spmd(
     job = None
     job_world = None
     results = None
+    # Accounting root: the whole supervised fit — every relaunch, every
+    # incarnation's chip-seconds — bills to ONE job. An ambient job
+    # (user-scoped) wins; gangs launched below inherit the scope.
+    # Entered manually so the supervisor loop keeps its indentation.
+    fit_job = _acct.ensure_job("fit-spmd", world_size=world_size)
+    _scope = _acct.job_scope(fit_job)
+    _scope.__enter__()
     try:
         while True:
             ds = _resharded(cur_world)
@@ -279,6 +288,13 @@ def fit_spmd(
                         **({"resume": os.path.basename(resume)}
                            if resume else {}),
                     )
+                    _events.emit(
+                        "train/resume", attempt=restarts,
+                        world_size=cur_world,
+                        resume_step=_ckpt_step(resume),
+                        **({"resume": os.path.basename(resume)}
+                           if resume else {}),
+                    )
                     logger.warning(
                         "fit_spmd: relaunching gang (restart %d/%d, "
                         "world %d%s)", restarts, max_restarts, cur_world,
@@ -297,6 +313,10 @@ def fit_spmd(
                 preempted = "PreemptionError" in err_text
                 if preempted:
                     _metrics.counter_add("preemptions/total")
+                    _events.emit(
+                        "preempt/request", attempt=restarts,
+                        world_size=cur_world,
+                    )
                 _flight.record(
                     "supervisor", "gang_failed", attempt=restarts,
                     world_size=cur_world, preempted=preempted,
@@ -326,6 +346,10 @@ def fit_spmd(
                             "fit_spmd: elastic resize %d -> %d ranks",
                             cur_world, got,
                         )
+                        _events.emit(
+                            "gang/resize", from_world=cur_world,
+                            to_world=got, attempt=restarts,
+                        )
                         cur_world = got
                 delay = restart_backoff_s * (2 ** (restarts - 1))
                 delay *= 1.0 + random.uniform(0.0, 0.25)  # decorrelate
@@ -350,6 +374,7 @@ def fit_spmd(
                 job.stop()
             except Exception:
                 pass
+        _scope.__exit__(None, None, None)
     rank0 = next(r for r in results if r["rank"] == 0)
     return {
         "history": rank0["history"],

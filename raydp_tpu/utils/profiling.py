@@ -208,6 +208,12 @@ class MetricsRegistry:
         with self._lock:
             self._gauges[name] = float(value)
 
+    def gauge_value(self, name: str) -> Optional[float]:
+        """Last value set on a gauge (None when never set) — read path
+        for integrators (HBM-byte-seconds accumulates gauge × dt)."""
+        with self._lock:
+            return self._gauges.get(name)
+
     def gauge_max(self, name: str, value: float) -> None:
         """Watermark gauge: keeps the max ever observed."""
         with self._lock:
@@ -334,7 +340,11 @@ class CompileError(RuntimeError):
     Carries everything a supervisor or retry loop needs to decide what
     to do, instead of a bare string: ``label`` (which jitted step),
     ``duration_s`` (how long the compile ran), ``endpoint`` /
-    ``http_status`` (set for remote-compile failures), ``xla_detail``
+    ``http_status`` (set for remote-compile failures),
+    ``server_exception`` (the service-side failure class parsed from
+    the HTTP body — exception name or helper exit code),
+    ``payload_bytes`` (size of the program's argument payload, the
+    lever that decides "too large for the helper"), ``xla_detail``
     (whatever compiler diagnostics the original text contained), and
     ``retryable`` — True only for remote-compile HTTP 5xx, where the
     compile *service* failed (helper OOM-killed, subprocess crash) and
@@ -350,6 +360,8 @@ class CompileError(RuntimeError):
         duration_s: float,
         endpoint: Optional[str] = None,
         http_status: Optional[int] = None,
+        server_exception: Optional[str] = None,
+        payload_bytes: Optional[int] = None,
         xla_detail: str = "",
         retryable: bool = False,
     ):
@@ -358,12 +370,40 @@ class CompileError(RuntimeError):
         self.duration_s = duration_s
         self.endpoint = endpoint
         self.http_status = http_status
+        self.server_exception = server_exception
+        self.payload_bytes = payload_bytes
         self.xla_detail = xla_detail
         self.retryable = retryable
 
 
+_SERVER_EXC_RE = None
+
+
+def _server_exception_class(body: str) -> Optional[str]:
+    """Service-side failure class from a remote-compile HTTP body:
+    a Python/C++ exception name when one is present, else the helper's
+    exit code (``subprocess-exit-N``)."""
+    global _SERVER_EXC_RE
+    if _SERVER_EXC_RE is None:
+        import re
+
+        _SERVER_EXC_RE = re.compile(
+            r"\b([A-Za-z_][\w.]*(?:Error|Exception))\b"
+            r"|subprocess exit code (\d+)"
+        )
+    m = _SERVER_EXC_RE.search(body or "")
+    if m is None:
+        return None
+    if m.group(1):
+        return m.group(1)
+    return f"subprocess-exit-{m.group(2)}"
+
+
 def enrich_compile_error(
-    exc: BaseException, duration_s: float, label: str
+    exc: BaseException,
+    duration_s: float,
+    label: str,
+    payload_bytes: Optional[int] = None,
 ) -> "CompileError":
     """Build an actionable, structured error for a failed XLA compile.
 
@@ -393,6 +433,7 @@ def enrich_compile_error(
     ]
     endpoint: Optional[str] = None
     http_status: Optional[int] = None
+    server_exception: Optional[str] = None
     detail = ""
     retryable = False
     m = _REMOTE_COMPILE_RE.search(text)
@@ -410,6 +451,16 @@ def enrich_compile_error(
         )
         detail = (body or "").strip()
         lines.append(f"  {detail if detail else '(no body)'}")
+        server_exception = _server_exception_class(detail)
+        if server_exception:
+            lines.append(
+                f"Service-side failure class: {server_exception}."
+            )
+        if payload_bytes:
+            lines.append(
+                f"Argument payload shipped with the program: "
+                f"{payload_bytes} bytes."
+            )
         lines.append(
             "Likely causes: the program is too large for the compile"
             " helper (seen at seq>=16384 dense attention — shrink the"
@@ -431,11 +482,37 @@ def enrich_compile_error(
         duration_s=duration_s,
         endpoint=endpoint,
         http_status=http_status,
+        server_exception=server_exception,
+        payload_bytes=payload_bytes,
         xla_detail=detail,
         retryable=retryable,
     )
     metrics.counter_add("compile/failures")
     metrics.counter_add("compile/seconds", duration_s)
+    # Timeline correlation: the failure lands in /debug/events next to
+    # whatever gang churn it caused (lazy import — telemetry.events
+    # imports this module's registry).
+    try:
+        from raydp_tpu.telemetry import events as _tl_events
+
+        _tl_events.emit(
+            "compile/failed",
+            label=label,
+            duration_s=round(duration_s, 3),
+            retryable=retryable,
+            **{
+                k: v
+                for k, v in (
+                    ("endpoint", endpoint),
+                    ("http_status", http_status),
+                    ("server_exception", server_exception),
+                    ("payload_bytes", payload_bytes),
+                )
+                if v
+            },
+        )
+    except Exception:
+        pass
     return err
 
 

@@ -148,6 +148,83 @@ np.testing.assert_allclose(
 )
 PYEOF
 fi
+# Job accounting smoke (HARD): two jobs running concurrently on one
+# driver must produce disjoint per-job usage (chip-seconds from their
+# fits, shuffle bytes from their exchanges) whose sums equal the
+# cluster-global totals, and the event-timeline CLI must render a
+# non-empty per-job timeline from the same run's shards — the
+# end-to-end proof of doc/telemetry.md's "Job accounting & event
+# timeline" story.
+if [ "$rc" -eq 0 ]; then
+  echo "--- job accounting smoke (2 concurrent jobs) ---"
+  acct_dir=$(mktemp -d)
+  JAX_PLATFORMS=cpu RAYDP_TPU_TELEMETRY_DIR="$acct_dir" python - <<'PYEOF' \
+    && JAX_PLATFORMS=cpu python -m raydp_tpu.telemetry.events "$acct_dir" \
+         | grep -q "== job" \
+    && echo "ACCOUNTING_SMOKE=ok" || { echo "ACCOUNTING_SMOKE=failed"; rc=1; }
+import threading
+
+import numpy as np
+import pandas as pd
+
+import raydp_tpu.dataframe as rdf
+from raydp_tpu import telemetry
+from raydp_tpu.dataframe import dataframe as D
+from raydp_tpu.utils.profiling import metrics
+
+# Force real exchanges: coalesced groupBys move no bytes to attribute.
+D._EXCHANGE_COALESCE_BYTES = 0
+D._AGG_COALESCE_BYTES = 0
+D._COMBINE_COALESCE_BYTES = 0
+
+
+def workload(job, seed):
+    rs = np.random.RandomState(seed)
+    pdf = pd.DataFrame(
+        {"k": rs.randint(0, 64, 20_000), "v": rs.rand(20_000)}
+    )
+    with telemetry.job_scope(job):
+        rdf.from_pandas(pdf, num_partitions=4) \
+            .groupBy("k").agg({"v": "sum"}).to_pandas()
+        from raydp_tpu.models.mlp import MLP
+        from raydp_tpu.train.estimator import JAXEstimator
+
+        x = rs.rand(256, 2).astype(np.float32)
+        tdf = pd.DataFrame(x, columns=["f0", "f1"])
+        tdf["label"] = x.sum(axis=1)
+        JAXEstimator(
+            model=MLP(hidden=(4,), out_dim=1), loss="mse",
+            num_epochs=1, batch_size=64,
+            feature_columns=["f0", "f1"], label_column="label",
+        ).fit_on_df(tdf)
+
+
+jobs = [telemetry.mint_job("smoke-a"), telemetry.mint_job("smoke-b")]
+threads = [
+    threading.Thread(target=workload, args=(j, i))
+    for i, j in enumerate(jobs)
+]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join()
+
+report = telemetry.usage_report({"driver": metrics.snapshot()})
+assert jobs[0].job_id != jobs[1].job_id
+for j in jobs:
+    usage = report["jobs"][j.job_id]["usage"]
+    assert usage.get("shuffle_bytes", 0) > 0, (j.job_id, usage)
+    assert usage.get("chip_seconds", 0) > 0, (j.job_id, usage)
+for kind in ("shuffle_bytes", "chip_seconds"):
+    total = report["totals"][kind]
+    per_job = sum(
+        r["usage"].get(kind, 0.0) for r in report["jobs"].values()
+    )
+    assert abs(total - per_job) <= 1e-6 * max(1.0, total), \
+        (kind, total, per_job)
+PYEOF
+  rm -rf "$acct_dir"
+fi
 # Bench regression gate (ADVISORY): when two result files exist, diff
 # the newest pair; a >10% throughput/MFU regression prints loudly but
 # never fails the tier-1 gate (bench noise on shared CI boxes is real

@@ -27,6 +27,7 @@ def _run(tmp_path, sources, rules=None, docs=None):
     pkg.mkdir(exist_ok=True)
     (pkg / "__init__.py").write_text("")
     for name, src in sources.items():
+        (pkg / name).parent.mkdir(parents=True, exist_ok=True)
         (pkg / name).write_text(textwrap.dedent(src))
     docs_dir = tmp_path / "doc"
     docs_dir.mkdir(exist_ok=True)
@@ -351,6 +352,53 @@ def test_r4_resolves_module_constants(tmp_path):
     """}, rules=["R4"], docs={"t.md": "raydp_fixture_total"})
     [f] = [f for f in res.findings if f.name == "unrouted-metric"]
     assert "watchdog/stalls" in f.message
+
+
+def test_r4_unattributed_ledger_metric_fires(tmp_path):
+    # Raw emits into the usage/job ledger namespaces outside the
+    # accounting module bypass per-job attribution — error even when
+    # the name is routed (export.py routes both prefixes).
+    res = _run(tmp_path, {"export.py": """
+        class _Family:
+            def __init__(self, name, kind):
+                self.name = name
+
+        _F = _Family("raydp_fixture_total", "counter")
+
+        def route(name):
+            if name.startswith("usage/") or name.startswith("job/"):
+                return _F
+            return None
+    """, "biller.py": """
+        def bill(metrics, job_id):
+            metrics.counter_add("usage/chip_seconds", 1.0)
+            metrics.counter_add(f"job/{job_id}/chip_seconds", 1.0)
+    """}, rules=["R4"], docs={"t.md": "raydp_fixture_total"})
+    bad = [f for f in res.findings if f.name == "unattributed-metric"]
+    assert len(bad) == 2
+    assert all(f.path.endswith("biller.py") for f in bad)
+    assert any("usage/chip_seconds" in f.message for f in bad)
+
+
+def test_r4_ledger_emit_in_accounting_module_is_clean(tmp_path):
+    # The accounting module IS the sanctioned emit path.
+    res = _run(tmp_path, {"export.py": """
+        class _Family:
+            def __init__(self, name, kind):
+                self.name = name
+
+        _F = _Family("raydp_fixture_total", "counter")
+
+        def route(name):
+            if name.startswith("usage/") or name.startswith("job/"):
+                return _F
+            return None
+    """, "telemetry/__init__.py": "", "telemetry/accounting.py": """
+        def add_usage(metrics, kind, job_id):
+            metrics.counter_add(f"usage/{kind}", 1.0)
+            metrics.counter_add(f"job/{job_id}/{kind}", 1.0)
+    """}, rules=["R4"], docs={"t.md": "raydp_fixture_total"})
+    assert [f for f in res.findings if f.name == "unattributed-metric"] == []
 
 
 # -- R5 jax hazards -----------------------------------------------------

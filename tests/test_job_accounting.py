@@ -1,0 +1,469 @@
+"""Job accounting plane: JobContext propagation, the usage ledger,
+the cluster event timeline, shard retention, and the SPMD
+health-report rank ageing (see doc/telemetry.md, "Job accounting &
+event timeline").
+"""
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from raydp_tpu.telemetry import accounting as acct
+from raydp_tpu.telemetry import events as tl_events
+from raydp_tpu.telemetry import export as tl_export
+from raydp_tpu.utils.profiling import metrics
+
+
+@pytest.fixture(autouse=True)
+def _clean_ambient_job():
+    """Each test starts with no ambient job and a clean thread scope."""
+    prev = acct.process_job()
+    acct.set_process_job(None)
+    yield
+    acct.set_process_job(prev)
+
+
+# -- JobContext propagation ---------------------------------------------
+
+
+def test_wire_round_trip():
+    ctx = acct.mint_job("etl-nightly", priority=3)
+    back = acct.from_wire(acct.to_wire(ctx))
+    assert back == ctx
+    assert acct.to_wire(None) is None
+    assert acct.from_wire(None) is None
+    assert acct.from_wire("") is None
+    assert acct.from_wire(42) is None
+
+
+def test_wire_tolerates_malformed_input():
+    # Missing fields default; a bad priority degrades to 0, never raises.
+    ctx = acct.from_wire("bare-id")
+    assert ctx.job_id == "bare-id" and ctx.name == "" and ctx.priority == 0
+    assert acct.from_wire("x;y;NaNa").priority == 0
+    assert acct.from_wire(";name;1") is None
+
+
+def test_job_ids_never_contain_separators():
+    # Ids embed in metric names (path segments) and the ';' wire format.
+    ctx = acct.mint_job("we/ird;na me")
+    assert ";" not in ctx.job_id and "/" not in ctx.job_id
+
+
+def test_scope_precedence_thread_over_process():
+    proc = acct.mint_job("proc-default")
+    acct.set_process_job(proc)
+    assert acct.current_job() == proc
+    override = acct.mint_job("explicit")
+    with acct.job_scope(override):
+        assert acct.current_job() == override
+        with acct.job_scope(None):  # clears the thread override only
+            assert acct.current_job() == proc
+    assert acct.current_job() == proc
+
+
+def test_scope_is_thread_local():
+    a, b = acct.mint_job("a"), acct.mint_job("b")
+    seen = {}
+
+    def worker():
+        with acct.job_scope(b):
+            time.sleep(0.02)
+            seen["thread"] = acct.current_job()
+
+    t = threading.Thread(target=worker)
+    with acct.job_scope(a):
+        t.start()
+        t.join()
+        seen["main"] = acct.current_job()
+    assert seen == {"thread": b, "main": a}
+
+
+def test_ensure_job_prefers_ambient():
+    ambient = acct.mint_job("ambient")
+    with acct.job_scope(ambient):
+        assert acct.ensure_job("fallback") == ambient
+    fresh = acct.ensure_job("fallback")
+    assert fresh.name == "fallback" and fresh != ambient
+
+
+def test_env_round_trip():
+    ctx = acct.mint_job("spawned", priority=1)
+    env = acct.env_for_child(ctx)
+    assert set(env) == {acct.JOB_ENV}
+    assert acct.job_from_env(env) == ctx
+    # Nothing in scope -> empty dict, safe to splat into a launch env.
+    assert acct.env_for_child() == {}
+    assert acct.job_from_env({}) is None
+
+
+def test_rpc_inject_extract():
+    ctx = acct.mint_job("rpc-caller")
+    with acct.job_scope(ctx):
+        req = acct.inject({"method": "RunTask"})
+    assert acct.extract(req) == ctx
+    # Copies, never mutates (retry loops reuse payload dicts).
+    bare = {"method": "RunTask"}
+    with acct.job_scope(ctx):
+        assert acct.inject(bare) is not bare
+    assert acct.JOB_KEY not in bare
+    # An explicit caller-provided job wins; no ambient job is a no-op.
+    pre = {"method": "X", acct.JOB_KEY: acct.to_wire(ctx)}
+    other = acct.mint_job("other")
+    with acct.job_scope(other):
+        assert acct.extract(acct.inject(pre)) == ctx
+    assert acct.inject({"m": 1}) == {"m": 1}
+    assert acct.extract("not-a-mapping") is None
+
+
+# -- the usage ledger ---------------------------------------------------
+
+
+def test_add_usage_bills_global_and_job():
+    ctx = acct.mint_job("ledger")
+    base = metrics.snapshot()["counters"].get("usage/chip_seconds", 0.0)
+    with acct.job_scope(ctx):
+        acct.add_usage(acct.CHIP_SECONDS, 2.5)
+    acct.add_usage(acct.CHIP_SECONDS, 1.0)  # unattributed: global only
+    counters = metrics.snapshot()["counters"]
+    assert counters["usage/chip_seconds"] == pytest.approx(base + 3.5)
+    assert counters[f"job/{ctx.job_id}/chip_seconds"] == pytest.approx(2.5)
+
+
+def test_add_usage_ignores_garbage():
+    ctx = acct.mint_job("garbage")
+    with acct.job_scope(ctx):
+        acct.add_usage(acct.TASK_SECONDS, 0.0)
+        acct.add_usage(acct.TASK_SECONDS, -5)
+        acct.add_usage(acct.TASK_SECONDS, "not-a-number")
+        acct.add_usage(acct.TASK_SECONDS, None)
+    counters = metrics.snapshot()["counters"]
+    assert f"job/{ctx.job_id}/task_seconds" not in counters
+
+
+def test_accounting_kill_switch(monkeypatch):
+    ctx = acct.mint_job("killed")
+    monkeypatch.setenv(acct.ACCOUNTING_ENV, "0")
+    with acct.job_scope(ctx):
+        acct.add_usage(acct.SHUFFLE_BYTES, 1024)
+    assert f"job/{ctx.job_id}/shuffle_bytes" not in \
+        metrics.snapshot()["counters"]
+
+
+def test_usage_report_folds_workers_and_driver():
+    job_a = acct.mint_job("report-a", priority=2)
+    job_b = acct.mint_job("report-b")
+    view = {
+        "workers": {
+            "w0": {"counters": {
+                f"job/{job_a.job_id}/task_seconds": 1.5,
+                f"job/{job_a.job_id}/shuffle_bytes": 100.0,
+                "worker/tasks": 7.0,  # non-ledger: ignored
+            }},
+            "w1": {"counters": {
+                f"job/{job_a.job_id}/task_seconds": 0.5,
+                f"job/{job_b.job_id}/task_seconds": 2.0,
+            }},
+        },
+        "driver": {"counters": {
+            f"job/{job_b.job_id}/chip_seconds": 4.0,
+            "usage/task_seconds": 4.0,
+        }},
+    }
+    report = acct.usage_report(view)
+    a = report["jobs"][job_a.job_id]
+    b = report["jobs"][job_b.job_id]
+    # Summed across workers; registry metadata joined in.
+    assert a["usage"]["task_seconds"] == pytest.approx(2.0)
+    assert a["usage"]["shuffle_bytes"] == pytest.approx(100.0)
+    assert a["name"] == "report-a" and a["priority"] == 2
+    assert b["usage"]["task_seconds"] == pytest.approx(2.0)
+    assert b["usage"]["chip_seconds"] == pytest.approx(4.0)
+    # Totals = sum over jobs, per kind.
+    assert report["totals"]["task_seconds"] == pytest.approx(4.0)
+    assert report["totals"]["chip_seconds"] == pytest.approx(4.0)
+
+
+def test_two_concurrent_jobs_bill_disjointly():
+    job_a, job_b = acct.mint_job("tenant-a"), acct.mint_job("tenant-b")
+
+    def run(job, n):
+        with acct.job_scope(job):
+            for _ in range(n):
+                acct.add_usage(acct.CHIP_SECONDS, 0.25)
+                acct.add_usage(acct.SHUFFLE_BYTES, 10)
+
+    ta = threading.Thread(target=run, args=(job_a, 8))
+    tb = threading.Thread(target=run, args=(job_b, 4))
+    ta.start(), tb.start()
+    ta.join(), tb.join()
+    counters = metrics.snapshot()["counters"]
+    assert counters[f"job/{job_a.job_id}/chip_seconds"] == \
+        pytest.approx(2.0)
+    assert counters[f"job/{job_b.job_id}/chip_seconds"] == \
+        pytest.approx(1.0)
+    assert counters[f"job/{job_a.job_id}/shuffle_bytes"] == \
+        pytest.approx(80)
+    assert counters[f"job/{job_b.job_id}/shuffle_bytes"] == \
+        pytest.approx(40)
+    report = acct.usage_report({"driver": {"counters": {
+        k: v for k, v in counters.items() if k.startswith("job/")
+    }}})
+    billed = sum(
+        report["jobs"][j.job_id]["usage"]["chip_seconds"]
+        for j in (job_a, job_b)
+    )
+    assert billed == pytest.approx(3.0)
+
+
+def test_prometheus_routes_job_families():
+    view = {"workers": {"w0": {"counters": {
+        "usage/chip_seconds": 3.5,
+        "job/jA/chip_seconds": 3.5,
+        "job/jA/task_seconds": 1.25,
+        "job/jA/shuffle_bytes": 2048.0,
+        "job/jA/staged_bytes": 512.0,
+        "job/jA/fetched_bytes": 128.0,
+        "job/jA/hbm_byte_seconds": 9.0,
+        "job/jA/compile_seconds": 7.5,
+        "job/jA/custom_kind": 1.0,
+    }}}}
+    text = tl_export.render_prometheus(view)
+    assert 'raydp_usage_total{kind="chip_seconds",worker="w0"} 3.5' in text
+    assert 'raydp_job_chip_seconds_total{job="jA",worker="w0"} 3.5' in text
+    assert 'raydp_job_task_seconds_total{job="jA",worker="w0"} 1.25' in text
+    assert ('raydp_job_bytes_total{job="jA",kind="shuffle",worker="w0"}'
+            ' 2048') in text
+    assert ('raydp_job_bytes_total{job="jA",kind="staged",worker="w0"}'
+            ' 512') in text
+    assert ('raydp_job_bytes_total{job="jA",kind="fetched",worker="w0"}'
+            ' 128') in text
+    assert ('raydp_job_hbm_byte_seconds_total{job="jA",worker="w0"}'
+            ' 9') in text
+    assert ('raydp_job_compile_seconds_total{job="jA",worker="w0"}'
+            ' 7.5') in text
+    # Unknown kinds land in the generic job-attributed fallback.
+    assert ('raydp_job_counter_total{job="jA",name="custom_kind",'
+            'worker="w0"} 1') in text
+    # Ledger names never leak into the generic raydp_counter_total.
+    assert 'raydp_counter_total{name="usage/' not in text
+    assert 'raydp_counter_total{name="job/' not in text
+
+
+# -- cluster event timeline ---------------------------------------------
+
+
+def test_emit_stamps_job_and_trace():
+    ctx = acct.mint_job("stamped")
+    with acct.job_scope(ctx):
+        rec = tl_events.emit("worker/spawn", worker="w3", host="h1")
+    assert rec["kind"] == "event" and rec["name"] == "worker/spawn"
+    assert rec["job"] == ctx.job_id and rec["job_name"] == "stamped"
+    assert rec["attrs"] == {"worker": "w3", "host": "h1"}
+    assert rec["duration_s"] == 0.0
+    assert rec["trace_id"] and rec["span_id"]
+    # And it landed in the local ring.
+    assert any(
+        r["span_id"] == rec["span_id"] for r in tl_events.local_events()
+    )
+
+
+def test_emit_explicit_job_wins_and_none_is_fine():
+    explicit = acct.mint_job("explicit-ev")
+    ambient = acct.mint_job("ambient-ev")
+    with acct.job_scope(ambient):
+        rec = tl_events.emit("gang/launch", job=explicit)
+    assert rec["job"] == explicit.job_id
+    rec = tl_events.emit("gang/teardown")
+    assert rec["job"] is None  # unattributed events are legal
+
+
+def test_events_write_through_and_cli(tmp_path, monkeypatch, capsys):
+    monkeypatch.setenv(tl_export.TELEMETRY_DIR_ENV, str(tmp_path))
+    ctx = acct.mint_job("shipped")
+    with acct.job_scope(ctx):
+        tl_events.emit("rank/dead", rank=1, rc=-9)
+        tl_events.emit("gang/teardown")
+        tl_events.emit("gang/launch", world_size=2)
+    records = tl_events.load_event_records(str(tmp_path))
+    names = [r["name"] for r in records if r["job"] == ctx.job_id]
+    # mint_job itself logs the birth of the job.
+    assert names == [
+        "job/start", "rank/dead", "gang/teardown", "gang/launch",
+    ]
+    # Job filter narrows to one timeline.
+    only = tl_events.load_event_records(str(tmp_path), job=ctx.job_id)
+    assert {r["job"] for r in only} == {ctx.job_id}
+    # The CLI renders it, MTTR included.
+    rc = tl_events.main([str(tmp_path), "--job", ctx.job_id])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert f"== job {ctx.job_id}" in out
+    assert "rank/dead" in out and "MTTR: 1 recovery episode(s)" in out
+    # --json emits machine-readable records + the MTTR report.
+    rc = tl_events.main([str(tmp_path), "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0 and ctx.job_id in payload["mttr"]
+
+
+def test_mttr_episode_causal_chain():
+    base = time.time()
+
+    def ev(name, dt, job="j1"):
+        return {"name": name, "job": job, "start_wall": base + dt, "seq": dt}
+
+    events = [
+        ev("gang/launch", 0),
+        ev("rank/dead", 10),
+        ev("gang/teardown", 11),
+        ev("checkpoint/emergency", 12),
+        ev("train/resume", 15),
+        ev("preempt/request", 30),  # second episode, never recovers
+    ]
+    report = tl_events.mttr_report(events)["j1"]
+    assert report["count"] == 1 and report["unresolved"]
+    [ep] = report["episodes"]
+    assert ep["start_kind"] == "rank/dead"
+    assert ep["end_kind"] == "train/resume"
+    assert ep["repair_s"] == pytest.approx(5.0)
+    # The intermediate causal steps are itemized, in order, with offsets.
+    assert [(s["kind"], s["dt_s"]) for s in ep["steps"]] == [
+        ("gang/teardown", pytest.approx(1.0)),
+        ("checkpoint/emergency", pytest.approx(2.0)),
+    ]
+
+
+def test_events_merge_into_chrome_trace(tmp_path, monkeypatch):
+    from raydp_tpu.telemetry.chrome_trace import (
+        load_span_records,
+        to_chrome_trace,
+    )
+
+    monkeypatch.setenv(tl_export.TELEMETRY_DIR_ENV, str(tmp_path))
+    ctx = acct.mint_job("perfetto")
+    with acct.job_scope(ctx):
+        tl_events.emit("gang/launch", world_size=1)
+    records = load_span_records(str(tmp_path))
+    assert any(r.get("name") == "gang/launch" for r in records)
+    trace = to_chrome_trace(records)
+    instants = [
+        e for e in trace["traceEvents"]
+        if e.get("ph") == "i" and e["name"] == "gang/launch"
+    ]
+    assert instants and instants[0]["args"]["job"] == ctx.job_id
+
+
+# -- shard retention ----------------------------------------------------
+
+
+def _mk_shards(tmp_path, kind, pids):
+    paths = []
+    for i, pid in enumerate(pids):
+        p = tmp_path / f"{kind}-{pid}.jsonl"
+        p.write_text("{}\n")
+        # Distinct mtimes, oldest first in pid order.
+        os.utime(p, (1_000_000 + i, 1_000_000 + i))
+        paths.append(p)
+    return paths
+
+
+def test_prune_shards_drops_oldest_first(tmp_path):
+    paths = _mk_shards(tmp_path, "spans", range(100, 110))
+    removed = tl_export.prune_shards(str(tmp_path), "spans", keep=3)
+    assert removed == 7
+    survivors = sorted(p.name for p in tmp_path.iterdir())
+    assert survivors == [p.name for p in paths[-3:]]
+
+
+def test_prune_shards_is_per_kind(tmp_path, monkeypatch):
+    monkeypatch.setenv(tl_export.SHARD_KEEP_ENV, "2")
+    _mk_shards(tmp_path, "spans", range(5))
+    _mk_shards(tmp_path, "events", range(5))
+    _mk_shards(tmp_path, "logs", range(5))
+    _mk_shards(tmp_path, "stats", range(5))
+    for kind in ("spans", "events", "logs", "stats"):
+        assert tl_export.prune_shards(str(tmp_path), kind) == 3
+    assert len(list(tmp_path.iterdir())) == 8  # 2 per kind
+
+
+def test_prune_shards_under_cap_is_noop(tmp_path):
+    _mk_shards(tmp_path, "events", range(3))
+    assert tl_export.prune_shards(str(tmp_path), "events", keep=5) == 0
+    assert len(list(tmp_path.iterdir())) == 3
+
+
+def test_shard_keep_env_default_and_floor(monkeypatch):
+    monkeypatch.delenv(tl_export.SHARD_KEEP_ENV, raising=False)
+    assert tl_export.shard_keep() == 64
+    monkeypatch.setenv(tl_export.SHARD_KEEP_ENV, "7")
+    assert tl_export.shard_keep() == 7
+    monkeypatch.setenv(tl_export.SHARD_KEEP_ENV, "0")
+    assert tl_export.shard_keep() == 1  # never prune to zero
+    monkeypatch.setenv(tl_export.SHARD_KEEP_ENV, "banana")
+    assert tl_export.shard_keep() == 64
+
+
+# -- SPMD health report: rank ageing (elastic-shrink regression) --------
+
+
+def _bare_job(world_size):
+    from raydp_tpu.spmd.job import SPMDJob
+
+    return SPMDJob("t", world_size=world_size, timeout=1.0)
+
+
+def test_health_report_departed_ranks_age_out():
+    # PR 10 regression: after an elastic shrink 4 -> 2, ranks 2 and 3
+    # keep their _rank_health keys (telemetry continuity) but must not
+    # linger as healthy members of a gang they left.
+    job = _bare_job(4)
+    now = time.monotonic()
+    for r in range(4):
+        job._rank_health[f"rank-{r}"] = {}
+        job._rank_beats[f"rank-{r}"] = now
+    job.world_size = 2  # elastic shrink
+    report = job.health_report()
+    assert sorted(report["ranks"]) == ["rank-0", "rank-1"]
+    assert report["departed_ranks"] == ["rank-2", "rank-3"]
+    assert report["dead_ranks"] == [] and report["late_ranks"] == []
+    assert report["healthy"] and report["world_size"] == 2
+
+
+def test_health_report_dead_and_late_vocabulary():
+    job = _bare_job(3)
+    now = time.monotonic()
+    job._rank_health = {f"rank-{r}": {} for r in range(3)}
+    job._rank_beats = {
+        "rank-0": now,                                # fresh
+        "rank-1": now - job.PING_TIMEOUT_S * 0.6,     # late, not dead
+        "rank-2": now - job.PING_TIMEOUT_S * 2,       # dead
+    }
+    report = job.health_report()
+    assert report["dead_ranks"] == ["rank-2"]
+    assert report["late_ranks"] == ["rank-1"]
+    assert not report["healthy"]
+
+
+def test_health_report_never_beaten_rank_ages_from_now():
+    # A gang that just launched has health keys but no beats yet; it
+    # must not be declared dead at t=0.
+    job = _bare_job(2)
+    job._rank_health = {"rank-0": {}, "rank-1": {}}
+    report = job.health_report()
+    assert report["dead_ranks"] == [] and report["late_ranks"] == []
+    assert report["healthy"]
+
+
+def test_health_report_stall_flags_still_surface():
+    job = _bare_job(2)
+    now = time.monotonic()
+    job._rank_health = {
+        "rank-0": {},
+        "rank-1": {"spmd/func": {"age_s": 80.0}},
+    }
+    job._rank_beats = {"rank-0": now, "rank-1": now}
+    report = job.health_report()
+    assert report["stalled_ranks"] == ["rank-1"]
+    assert not report["healthy"]
