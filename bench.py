@@ -1909,6 +1909,197 @@ def bench_fault_tolerance():
     }
 
 
+def bench_multi_tenant():
+    """Control-plane evidence (doc/scheduling.md): (a) fair-share —
+    two equal ETL tenants at different priorities contend for one
+    arbiter slot through stage turns, reported as throughput plus the
+    usage-ledger task-seconds split; (b) preemption MTTR — a
+    high-priority arrival evicts a low-priority training gang,
+    measured sched/preempt -> sched/resume on the event timeline; (c)
+    queue-wait p50 from the arbiter report. Victim/arrival loss
+    parity with the ledger split is the correctness signal."""
+    import threading
+
+    import pandas as pd
+
+    import raydp_tpu.dataframe as rdf
+    from raydp_tpu import control, telemetry
+    from raydp_tpu.data import MLDataset
+    from raydp_tpu.telemetry import events as _events
+    from raydp_tpu.train.spmd_fit import fit_spmd
+    from raydp_tpu.utils.profiling import metrics as _metrics
+
+    out = {}
+    control.reset_for_tests()
+    try:
+        arb = control.configure(capacity=1, admit_timeout_s=240.0)
+
+        # -- (a) fair-share ETL split under turn contention ----------
+        n_rows, etl_iters = 60_000, 4
+        rs = np.random.RandomState(11)
+        pdf = pd.DataFrame({
+            "k": rs.randint(0, 256, n_rows),
+            "v": rs.rand(n_rows),
+        })
+        hi = telemetry.mint_job("mt-hi", priority=4)
+        lo = telemetry.mint_job("mt-lo", priority=0)
+        tenant_s = {}
+
+        def tenant(key, job):
+            t0 = time.perf_counter()
+            with telemetry.job_scope(job):
+                for _ in range(etl_iters):
+                    rdf.from_pandas(pdf, num_partitions=4) \
+                        .groupBy("k").agg({"v": "sum"}).to_pandas()
+            tenant_s[key] = time.perf_counter() - t0
+
+        # Force the real exchange path so the usage ledger has bytes
+        # to attribute (coalesced groupBys move nothing) — same
+        # discipline as bench_job_accounting. task_seconds is billed
+        # by cluster ETL workers only, so the driver-local split is
+        # read from shuffle_bytes instead.
+        from raydp_tpu.dataframe import dataframe as D
+        saved = (D._EXCHANGE_COALESCE_BYTES, D._AGG_COALESCE_BYTES,
+                 D._COMBINE_COALESCE_BYTES)
+        D._EXCHANGE_COALESCE_BYTES = 0
+        D._AGG_COALESCE_BYTES = 0
+        D._COMBINE_COALESCE_BYTES = 0
+        t0 = time.perf_counter()
+        try:
+            threads = [
+                threading.Thread(target=tenant, args=(k, j))
+                for k, j in (("hi", hi), ("lo", lo))
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        finally:
+            (D._EXCHANGE_COALESCE_BYTES, D._AGG_COALESCE_BYTES,
+             D._COMBINE_COALESCE_BYTES) = saved
+        etl_s = time.perf_counter() - t0
+        usage = telemetry.usage_report({"driver": _metrics.snapshot()})
+        hi_sb = usage["jobs"].get(hi.job_id, {}) \
+            .get("usage", {}).get("shuffle_bytes", 0.0)
+        lo_sb = usage["jobs"].get(lo.job_id, {}) \
+            .get("usage", {}).get("shuffle_bytes", 0.0)
+        out["etl_rows_per_sec"] = round(2 * etl_iters * n_rows / etl_s, 1)
+        out["tenant_wall_s"] = {
+            k: round(v, 3) for k, v in sorted(tenant_s.items())
+        }
+        out["ledger_shuffle_bytes"] = {"hi": hi_sb, "lo": lo_sb}
+        # Equal offered work -> the split converging on 0.5 is the
+        # fairness evidence; a hi-skewed split means lo was starved.
+        out["fair_share_hi_frac"] = round(
+            hi_sb / (hi_sb + lo_sb) if hi_sb + lo_sb else 0.0, 4
+        )
+
+        # -- (b) scheduler-driven preemption MTTR --------------------
+        n_train = 2_048
+        a, b = rs.randn(n_train), rs.randn(n_train)
+        tpdf = pd.DataFrame({"a": a, "b": b, "y": 2 * a - 3 * b + 1})
+        ds = MLDataset.from_df(
+            rdf.from_pandas(tpdf, num_partitions=2), num_shards=1
+        )
+        arrival_ds = MLDataset.from_df(
+            rdf.from_pandas(tpdf.head(512), num_partitions=2),
+            num_shards=1,
+        )
+
+        def factory_builder(ckpt, num_epochs, save_every=0):
+            def make_estimator():
+                import jax
+                import optax
+
+                from raydp_tpu.models import MLP
+                from raydp_tpu.parallel import MeshSpec
+                from raydp_tpu.train import JAXEstimator
+
+                return JAXEstimator(
+                    model=MLP(hidden=(16,), out_dim=1),
+                    optimizer=optax.adam(3e-2),
+                    loss="mse", num_epochs=num_epochs, batch_size=128,
+                    feature_columns=["a", "b"], label_column="y",
+                    mesh=MeshSpec(dp=len(jax.devices())), seed=0,
+                    shuffle=False, epoch_mode="stream",
+                    checkpoint_dir=ckpt, save_every_steps=save_every,
+                )
+
+            return make_estimator
+
+        root = tempfile.mkdtemp(prefix="bench-mt-")
+        victim_dir = os.path.join(root, "victim")
+        victim_job = telemetry.mint_job("mt-victim", priority=0)
+        victim_out = {}
+
+        def run_victim():
+            with telemetry.job_scope(victim_job):
+                try:
+                    victim_out["res"] = fit_spmd(
+                        factory_builder(victim_dir, 8, save_every=2),
+                        ds, world_size=1,
+                        env={"JAX_PLATFORMS": "cpu"}, timeout=300,
+                        checkpoint_dir=victim_dir,
+                    )
+                except Exception as exc:  # noqa: BLE001 - reported
+                    victim_out["err"] = repr(exc)
+
+        t0 = time.perf_counter()
+        vt = threading.Thread(target=run_victim, daemon=True)
+        vt.start()
+        # Preempt only once the victim is mid-epoch (first periodic
+        # checkpoint committed), same discipline as SCHED_SMOKE.
+        mid = os.path.join(victim_dir, "step_mid_2", "_METADATA")
+        deadline = time.monotonic() + 240.0
+        while time.monotonic() < deadline and not os.path.isfile(mid):
+            time.sleep(0.05)
+        with telemetry.job_scope(telemetry.mint_job("mt-arrival",
+                                                    priority=5)):
+            arrival = fit_spmd(
+                factory_builder(None, 1), arrival_ds, world_size=1,
+                env={"JAX_PLATFORMS": "cpu"}, timeout=300,
+            )
+        vt.join(300.0)
+        wall_s = time.perf_counter() - t0
+
+        victim = victim_out.get("res") or {}
+        mttr = _events.mttr_report(_events.local_events()) \
+            .get(victim_job.job_id, {})
+        preempt_eps = [
+            e for e in mttr.get("episodes", [])
+            if e["start_kind"] == "sched/preempt"
+        ]
+        out.update({
+            # victim + arrival samples over the contended wall time
+            "samples_per_sec": round(
+                (8 * n_train + 512) / wall_s, 1
+            ),
+            "unit": "samples/s",
+            "preemptions": len(preempt_eps),
+            "preempt_mttr_s": round(preempt_eps[0]["repair_s"], 3)
+            if preempt_eps else None,
+            "victim_restarts": victim.get("restarts"),
+            "arrival_restarts": arrival["restarts"],
+            "victim_err": victim_out.get("err"),
+        })
+
+        # -- (c) queue-wait p50 from the arbiter report --------------
+        rep = arb.report()
+        out["queue_wait_p50_s"] = rep.get("wait_p50_s")
+        # sched/wait/<job_id> keys are per-run-unique: keep only the
+        # aggregate families so bench_compare diffs stay stable.
+        out["sched_counters"] = {
+            k: v for k, v in sorted(
+                _metrics.snapshot().get("counters", {}).items()
+            ) if k.startswith(("sched/preemptions/", "sched/sheds"))
+        }
+    finally:
+        # The matrix shares this process: later entries must not run
+        # under a capacity-1 arbiter.
+        control.reset_for_tests()
+    return out
+
+
 def _capture_gang_profile() -> dict:
     """``--profile``: spin a 2-rank SPMD gang running a small stream
     fit and gang-capture a trace mid-training; the merged Perfetto path
@@ -2004,6 +2195,9 @@ CPU_MATRIX = [
     # Recovery cost (MTTR) of the supervised gang under an injected
     # rank kill; host-side, loss parity is the correctness gate.
     ("fault_tolerance", bench_fault_tolerance),
+    # Multi-tenant control plane: fair-share turn split, scheduler
+    # preemption MTTR, queue-wait p50 (doc/scheduling.md).
+    ("multi_tenant", bench_multi_tenant),
     # Ingest is bandwidth-sensitive: keep it ahead of the model configs
     # that leave host-memory pressure behind.
     ("ingest_device_feed", bench_ingest),

@@ -652,6 +652,17 @@ class Cluster:
 
         return _acct.usage_report(self.metrics_snapshot())
 
+    def scheduler_report(self) -> dict:
+        """Control-plane arbiter state (parity with
+        :meth:`usage_report`): capacity, in-use slots, admission-queue
+        contents in grant order, active leases, per-job lifecycle
+        states, and queue-wait statistics. ``{"enabled": False, ...}``
+        when arbitration is off (``RAYDP_TPU_SCHED_CAPACITY`` unset —
+        the single-tenant default; see doc/scheduling.md)."""
+        from raydp_tpu.control import get_arbiter
+
+        return get_arbiter().report()
+
     def events_report(self, job: Optional[str] = None) -> dict:
         """The cluster event timeline + MTTR report (parity with
         :meth:`usage_report`); also served at ``/debug/events``."""

@@ -1,0 +1,36 @@
+"""Multi-tenant control plane: admission, fair share, preemption.
+
+Public surface of :mod:`raydp_tpu.control.arbiter` — see
+``doc/scheduling.md`` for the state machine and semantics.
+"""
+from raydp_tpu.control.arbiter import (
+    SCHED_ADMIT_TIMEOUT_ENV,
+    SCHED_CAPACITY_ENV,
+    SCHED_LEASE_TTL_ENV,
+    SCHED_MAX_QUEUE_ENV,
+    SCHED_PREEMPT_TIMEOUT_ENV,
+    SCHED_PRESSURE_ENV,
+    ClusterArbiter,
+    ClusterBusyError,
+    Lease,
+    configure,
+    get_arbiter,
+    reset_for_tests,
+    stage_gate,
+)
+
+__all__ = [
+    "SCHED_ADMIT_TIMEOUT_ENV",
+    "SCHED_CAPACITY_ENV",
+    "SCHED_LEASE_TTL_ENV",
+    "SCHED_MAX_QUEUE_ENV",
+    "SCHED_PREEMPT_TIMEOUT_ENV",
+    "SCHED_PRESSURE_ENV",
+    "ClusterArbiter",
+    "ClusterBusyError",
+    "Lease",
+    "configure",
+    "get_arbiter",
+    "reset_for_tests",
+    "stage_gate",
+]

@@ -57,15 +57,27 @@ def _ensure_etl_job() -> None:
         _acct.set_process_job(_acct.mint_job("etl"))
 
 
+@contextlib.contextmanager
 def _stage_span(op: str, n_parts: int, executor: str, **attrs):
     """Span + counter around one stage execution (driver side: covers
     submit AND result gather on the cluster backend, so the duration is
     the stage's wall time as the query planner experiences it). Under
     streaming dispatch the span covers scheduling only — completion
-    happens on callback threads and the true wall lands in StageStats."""
+    happens on callback threads and the true wall lands in StageStats.
+
+    Stages are also the control plane's fair-share interleaving points:
+    each execution passes through the arbiter's ``stage_gate`` (a
+    transient one-slot "turn" granted in deficit-weighted round-robin
+    order across tenants; doc/scheduling.md) — a no-op unless
+    ``RAYDP_TPU_SCHED_CAPACITY`` enables arbitration."""
+    from raydp_tpu.control import stage_gate
+
     _ensure_etl_job()
     metrics.counter_add("df/stages")
-    return span("df/stage", op=op, parts=n_parts, executor=executor, **attrs)
+    with stage_gate(label=op), span(
+        "df/stage", op=op, parts=n_parts, executor=executor, **attrs
+    ):
+        yield
 
 
 # -- per-stage runtime statistics ------------------------------------------

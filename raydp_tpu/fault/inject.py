@@ -67,6 +67,25 @@ def ambient_rank() -> Optional[int]:
         return None
 
 
+def _ambient_job() -> tuple:
+    """``(job_id, name)`` of the job this process's work is billed to.
+
+    Reads the accounting plane's ambient job (thread scope, process
+    default, or ``RAYDP_TPU_JOB`` adoption in worker mains) so
+    ``job=``-targeted clauses fire only in the right tenant. Returns
+    ``(None, None)`` when no job is in scope.
+    """
+    try:
+        from raydp_tpu.telemetry import accounting as _acct
+
+        ctx = _acct.current_job()
+        if ctx is None:
+            return (None, None)
+        return (ctx.job_id, ctx.name)
+    except Exception:
+        return (None, None)
+
+
 def _clauses() -> List[FaultClause]:
     text = os.environ.get("RAYDP_TPU_FAULT_PLAN")
     if not text:
@@ -122,8 +141,11 @@ def on_train_step(step: int, rank: Optional[int] = None) -> None:
         return
     if rank is None:
         rank = ambient_rank()
+    job_id, job_name = _ambient_job()
     for c in clauses:
         if not c.armed or c.fired:
+            continue
+        if not c.matches_job(job_id, job_name):
             continue
         if c.kind == "kill" and c.step is not None and c.step == step:
             if c.matches_rank(rank):
@@ -137,8 +159,14 @@ def on_train_step(step: int, rank: Optional[int] = None) -> None:
 
 def on_task(worker_id: str, task_index: int) -> None:
     """Hook when an ETL worker begins its ``task_index``-th task."""
-    for c in _clauses():
+    clauses = _clauses()
+    if not clauses:
+        return
+    job_id, job_name = _ambient_job()
+    for c in clauses:
         if not c.armed or c.fired:
+            continue
+        if not c.matches_job(job_id, job_name):
             continue
         if c.kind == "kill" and c.task is not None and c.task == task_index:
             if c.matches_worker(worker_id):

@@ -12,13 +12,19 @@ Kinds and their keys (see ``doc/fault_tolerance.md`` for semantics):
     ``rank=N,step=K[,code=C]`` — SPMD rank ``N`` hard-exits with code
     ``C`` (default 23) when its estimator reaches train step ``K``; or
     ``worker=ID,task=K[,code=C]`` — ETL worker ``ID`` hard-exits when
-    it starts its ``K``-th task (0-based).
+    it starts its ``K``-th task (0-based). Either form may target
+    ``job=NAME`` instead of (or in addition to) ``rank``/``worker``:
+    the clause then only fires in a process whose ambient job
+    (``RAYDP_TPU_JOB`` propagation) has that name or job id — the
+    multi-tenant analogue of rank targeting.
 ``preempt``
-    ``step=K[,rank=N][,grace=S]`` — deliver a preemption notice at
-    train step ``K`` (all ranks unless ``rank`` is given; injected
-    slice preemption takes the whole gang, matching TPU semantics).
-    ``grace`` overrides ``RAYDP_TPU_PREEMPT_GRACE_S`` for the
-    force-exit deadline.
+    ``step=K[,rank=N][,job=NAME][,grace=S]`` — deliver a preemption
+    notice at train step ``K`` (all ranks unless ``rank`` is given;
+    injected slice preemption takes the whole gang, matching TPU
+    semantics). ``job=NAME`` restricts the notice to gangs of that
+    job, so a chaos sweep over a shared cluster preempts one tenant
+    deterministically. ``grace`` overrides
+    ``RAYDP_TPU_PREEMPT_GRACE_S`` for the force-exit deadline.
 ``rpc_delay``
     ``method=M,nth=K,delay=S`` — the ``K``-th (0-based) client call of
     RPC method ``M`` (bare or ``Service.Method``) sleeps ``S`` seconds
@@ -55,8 +61,8 @@ _REQUIRED: Dict[str, tuple] = {
 }
 
 _ALLOWED: Dict[str, tuple] = {
-    "kill": ("rank", "step", "worker", "task", "code", "prob"),
-    "preempt": ("step", "rank", "grace", "prob"),
+    "kill": ("rank", "step", "worker", "task", "code", "job", "prob"),
+    "preempt": ("step", "rank", "grace", "job", "prob"),
     "rpc_delay": ("method", "nth", "delay", "prob"),
     "rpc_drop": ("method", "nth", "prob"),
     "hb_stall": ("rank", "worker", "beats", "after", "prob"),
@@ -77,6 +83,7 @@ class FaultClause:
     kind: str
     rank: Optional[int] = None
     worker: Optional[str] = None
+    job: Optional[str] = None
     step: Optional[int] = None
     task: Optional[int] = None
     code: int = 23
@@ -95,6 +102,17 @@ class FaultClause:
 
     def matches_worker(self, worker: Optional[str]) -> bool:
         return self.worker is None or (worker is not None and worker == self.worker)
+
+    def matches_job(self, job_id: Optional[str], name: Optional[str]) -> bool:
+        """True when the ambient job satisfies the ``job=`` target.
+
+        Matches either the human name or the minted job id, so plans
+        can be written before ids exist. ``job=`` with no ambient job
+        never matches (a clause must not fire in unattributed work).
+        """
+        if self.job is None:
+            return True
+        return self.job in {j for j in (job_id, name) if j is not None}
 
     def matches_method(self, qualified: str) -> bool:
         if self.method is None:
@@ -166,10 +184,14 @@ def parse_plan(text: str, seed: int = 0) -> List[FaultClause]:
                     "fault plan: kill clause needs exactly one of step= (train "
                     "rank) or task= (ETL worker)"
                 )
-            if "step" in kwargs and "rank" not in kwargs:
-                raise FaultPlanError("fault plan: kill step= clause needs rank=")
-            if "task" in kwargs and "worker" not in kwargs:
-                raise FaultPlanError("fault plan: kill task= clause needs worker=")
+            if "step" in kwargs and "rank" not in kwargs and "job" not in kwargs:
+                raise FaultPlanError(
+                    "fault plan: kill step= clause needs rank= or job="
+                )
+            if "task" in kwargs and "worker" not in kwargs and "job" not in kwargs:
+                raise FaultPlanError(
+                    "fault plan: kill task= clause needs worker= or job="
+                )
         if kind == "preempt" and "step" not in kwargs:
             raise FaultPlanError("fault plan: preempt clause requires key 'step'")
         if kind == "hb_stall" and "rank" not in kwargs and "worker" not in kwargs:

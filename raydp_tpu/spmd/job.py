@@ -185,6 +185,10 @@ class SPMDJob:
         self._owns_trace_ctx = False
         self._job_ctx: Optional[_acct.JobContext] = None
         self._owns_job_ctx = False
+        # Control-plane lease held by start() for standalone gangs
+        # (None when a supervisor such as fit_spmd already admitted
+        # this job, or when the arbiter is disabled).
+        self._sched_lease = None
         # Per-rank metrics merged from heartbeat-shipped deltas; survives
         # gang restarts (ranks keep their keys across incarnations).
         self.telemetry = ClusterTelemetry()
@@ -269,6 +273,17 @@ class SPMDJob:
                 self.job_name, world_size=self.world_size
             )
             _acct.set_process_job(self._job_ctx)
+        # Control-plane admission (doc/scheduling.md): a gang acquires
+        # capacity BEFORE spawning ranks, blocking in the admission
+        # queue when the cluster is full. No-op when the arbiter is
+        # disabled or a supervisor (fit_spmd) already holds this job's
+        # lease; raises ClusterBusyError on shed/timeout.
+        from raydp_tpu.control import get_arbiter
+
+        self._sched_lease = get_arbiter().ensure_admitted(
+            self._job_ctx, slots=self.world_size, label=self.job_name,
+            on_preempt=self.request_preemption,
+        )
 
         log_dir = os.path.join(
             "/tmp/raydp_tpu", "spmd", f"{self.job_name}-{os.getpid()}"
@@ -721,6 +736,44 @@ class SPMDJob:
         finally:
             self._inflight = None
 
+    def request_preemption(self) -> None:
+        """Deliver a preemption notice to every live rank (driver side)
+        — the scheduler's victim-teardown hook.
+
+        Primary delivery is the worker RPC plane (``Preempt``): each
+        rank's handler sets the in-process drain flag, so the rank
+        finishes its in-flight step, writes an emergency checkpoint,
+        and raises :class:`~raydp_tpu.fault.PreemptionError` — exactly
+        the path an injected slice preemption takes. RPC rather than
+        SIGTERM because ``jax.distributed`` installs its own SIGTERM
+        handler (TSL's preemption notifier) over the Python drain
+        handler once a rank initializes, eating the signal. SIGTERM is
+        kept as the fallback for ranks not yet registered. Ranks
+        already gone are skipped; the whole call is advisory and never
+        raises."""
+        _events.emit(
+            "preempt/request", job=self._job_ctx, gang=self.job_name,
+            source="scheduler", gen=self._gen,
+        )
+        _flight.record("supervisor", "preempt_notice", job=self.job_name,
+                       ranks=len(self._procs))
+        notified = set()
+        for rank, stub in list(self._stubs.items()):
+            try:
+                if stub.try_call("Preempt", {}, timeout=5.0) is not None:
+                    notified.add(rank)
+            except Exception:
+                pass
+        import signal as _signal
+
+        for rank, proc in enumerate(self._procs):
+            if rank in notified or proc.poll() is not None:
+                continue
+            try:
+                proc.send_signal(_signal.SIGTERM)
+            except OSError:
+                pass
+
     def get_rank_addresses(self) -> List[str]:
         """Host of each rank, rank-ordered (reference: mpi_job.py:337-339)."""
         return [self._worker_hosts[r] for r in range(self.world_size)]
@@ -765,6 +818,12 @@ class SPMDJob:
                 trace_prop.set_process_context(None)
         self._trace_ctx = None
         self._owns_trace_ctx = False
+        if self._sched_lease is not None:
+            try:
+                self._sched_lease.release()
+            except Exception:
+                pass
+            self._sched_lease = None
         if self._owns_job_ctx and self._job_ctx is not None:
             if _acct.process_job() == self._job_ctx:
                 _acct.set_process_job(None)

@@ -106,6 +106,7 @@ class SPMDWorker:
                 "RunFunction": self._on_run_function,
                 "Stop": self._on_stop,
                 "ProfileRequest": self._on_profile,
+                "Preempt": self._on_preempt,
             },
             host="0.0.0.0" if multihost else "127.0.0.1",
         )
@@ -122,6 +123,20 @@ class SPMDWorker:
         self._stop_event.set()
         self._queue.put(None)
         return {"stopping": True}
+
+    def _on_preempt(self, req: dict) -> dict:
+        """Scheduler-driven preemption notice (driver ``Preempt`` RPC).
+
+        Sets the same in-process drain flag a SIGTERM would: the
+        training loop finishes the in-flight step, writes an emergency
+        checkpoint, and raises PreemptionError. Delivered over RPC
+        because ``jax.distributed`` replaces the Python SIGTERM handler
+        with TSL's preemption notifier once initialized."""
+        grace = req.get("grace_s")
+        _fault.request_preemption(
+            grace_s=float(grace) if grace is not None else None
+        )
+        return {"preempting": True, "rank": self.rank}
 
     def _on_profile(self, req: dict) -> dict:
         """Gang-coordinated trace capture: runs ON the RPC handler

@@ -73,10 +73,11 @@ _seq = itertools.count(1)
 #: progress again). Everything between them in a job's timeline is the
 #: causal repair chain the MTTR breakdown itemizes.
 FAILURE_KINDS = frozenset(
-    {"rank/dead", "worker/dead", "gang/failed", "preempt/request"}
+    {"rank/dead", "worker/dead", "gang/failed", "preempt/request",
+     "sched/preempt"}
 )
 RECOVERY_KINDS = frozenset(
-    {"train/resume", "worker/restart", "gang/launch"}
+    {"train/resume", "worker/restart", "gang/launch", "sched/resume"}
 )
 
 

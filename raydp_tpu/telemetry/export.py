@@ -439,6 +439,26 @@ def render_prometheus(view: Dict[str, Any]) -> str:
         "Job-attributed counters without a dedicated family, one "
         "series per (worker, job, name).",
     )
+    sched_queue_depth = _Family(
+        "raydp_sched_queue_depth", "gauge",
+        "Jobs waiting in the control-plane admission queue (driver "
+        "arbiter; see doc/scheduling.md).",
+    )
+    sched_preemptions = _Family(
+        "raydp_sched_preemptions_total", "counter",
+        "Scheduler-initiated preemptions by reason (reason=priority|"
+        "pressure|lease_timeout).",
+    )
+    sched_wait = _Family(
+        "raydp_sched_wait_seconds_total", "counter",
+        "Cumulative admission-queue wait per job — the fairness/latency "
+        "cost a tenant paid before each capacity grant.",
+    )
+    sched_sheds = _Family(
+        "raydp_sched_sheds_total", "counter",
+        "Admissions rejected with ClusterBusyError by the load-shedding "
+        "cap (queue at RAYDP_TPU_SCHED_MAX_QUEUE or explicit shed mode).",
+    )
 
     sources: Dict[str, Dict[str, Any]] = dict(view.get("workers") or {})
     driver = view.get("driver")
@@ -595,6 +615,23 @@ def render_prometheus(view: Dict[str, Any]) -> str:
                             {"worker": worker_id}, section[name]
                         )
                         continue
+                    if name.startswith("sched/preemptions/"):
+                        sched_preemptions.add(
+                            {"worker": worker_id,
+                             "reason": name[len("sched/preemptions/"):]},
+                            section[name],
+                        )
+                        continue
+                    if name.startswith("sched/wait/"):
+                        sched_wait.add(
+                            {"worker": worker_id,
+                             "job": name[len("sched/wait/"):]},
+                            section[name],
+                        )
+                        continue
+                    if name == "sched/sheds":
+                        sched_sheds.add({"worker": worker_id}, section[name])
+                        continue
                     counters.add(
                         {"worker": worker_id, "name": name}, section[name]
                     )
@@ -622,6 +659,8 @@ def render_prometheus(view: Dict[str, Any]) -> str:
                              else "current"},
                             value,
                         )
+                    elif name == "sched/queue_depth":
+                        sched_queue_depth.add({"worker": worker_id}, value)
                     elif name == "mfu":
                         mfu.add({"worker": worker_id}, value)
                     else:
@@ -679,6 +718,8 @@ def render_prometheus(view: Dict[str, Any]) -> str:
                    usage_total, job_chip_seconds, job_task_seconds,
                    job_bytes, job_hbm_byte_seconds, job_compile_seconds,
                    job_counter,
+                   sched_queue_depth, sched_preemptions, sched_wait,
+                   sched_sheds,
                    host_rss,
                    hbm_bytes, store_occupancy, mfu, anomalies, step_hist,
                    generic_hist, gauges):

@@ -38,6 +38,16 @@ from raydp_tpu.telemetry import overlap as _overlap
 from raydp_tpu.telemetry import watchdog as _watchdog
 from raydp_tpu.train.losses import resolve_loss, resolve_metric
 
+#: Retention cap for step-encoded checkpoints (``step_mid_<N>`` /
+#: ``step_emergency_<N>``). Long preemption-heavy runs accumulate one
+#: directory per save interval plus one per drain; beyond this many,
+#: the oldest complete ones are pruned after each successful save
+#: (mirrors ``RAYDP_TPU_SHARD_KEEP`` for telemetry shards). ``0``
+#: disables pruning. Epoch-end (``step_<E>``) and ``final``
+#: checkpoints are never pruned.
+CKPT_KEEP_ENV = "RAYDP_TPU_CKPT_KEEP"
+_DEFAULT_CKPT_KEEP = 16
+
 logger = logging.getLogger(__name__)
 
 
@@ -1387,6 +1397,11 @@ class JAXEstimator:
         )
         ckptr.wait_until_finished()
         _events.emit("checkpoint/complete", path=str(path), step=str(step))
+        # Retention runs only on the primary host (the one orbax wrote
+        # from); other ranks returning early here is safe because prune
+        # never touches the checkpoint just written.
+        if jax.process_index() == 0:
+            _prune_checkpoints(checkpoint_dir)
         return str(path)
 
     def restore(self, checkpoint_dir: str, step=None,
@@ -1518,6 +1533,62 @@ def _ckpt_path(checkpoint_dir: str, step: Optional[int]):
 
     name = f"step_{step}" if step is not None else "final"
     return os.path.abspath(os.path.join(checkpoint_dir, name))
+
+
+def _ckpt_keep() -> int:
+    raw = os.environ.get(CKPT_KEEP_ENV, "")
+    try:
+        return max(0, int(raw)) if raw else _DEFAULT_CKPT_KEEP
+    except ValueError:
+        return _DEFAULT_CKPT_KEEP
+
+
+def _prune_checkpoints(checkpoint_dir: str) -> List[str]:
+    """Drop the oldest step-encoded checkpoints beyond the retention cap.
+
+    Only *complete* ``step_mid_<N>`` / ``step_emergency_<N>``
+    directories (orbax ``_METADATA`` present) count against
+    ``RAYDP_TPU_CKPT_KEEP`` and only those are removed — a directory
+    without metadata may be a save still committing, and epoch-end /
+    ``final`` checkpoints are durable artifacts, not a ring. Ordered by
+    the optimizer step in the name, so the newest complete checkpoint
+    always survives and resume-after-prune finds it. Returns the pruned
+    paths (for tests and the prune event).
+    """
+    import re
+    import shutil
+
+    keep = _ckpt_keep()
+    if keep <= 0:
+        return []
+    step_re = re.compile(r"^step_(?:mid|emergency)_(\d+)$")
+    candidates = []
+    try:
+        names = os.listdir(checkpoint_dir)
+    except OSError:
+        return []
+    for name in names:
+        m = step_re.match(name)
+        if not m:
+            continue
+        path = os.path.join(checkpoint_dir, name)
+        if not os.path.isfile(os.path.join(path, "_METADATA")):
+            continue
+        candidates.append((int(m.group(1)), path))
+    if len(candidates) <= keep:
+        return []
+    candidates.sort()
+    pruned = []
+    for step_n, path in candidates[: len(candidates) - keep]:
+        try:
+            shutil.rmtree(path)
+        except OSError:
+            continue
+        pruned.append(path)
+        _events.emit(
+            "checkpoint/prune", path=path, step=str(step_n), keep=keep
+        )
+    return pruned
 
 
 def _ckpt_has_keys(path: str, keys) -> Optional[bool]:

@@ -217,8 +217,43 @@ def fit_spmd(
     fit_job = _acct.ensure_job("fit-spmd", world_size=world_size)
     _scope = _acct.job_scope(fit_job)
     _scope.__enter__()
+
+    def _preempt_gang() -> None:
+        # Scheduler victim hook: SIGTERM the CURRENT incarnation's
+        # ranks (closure reads the live ``job`` binding) so they drain
+        # to an emergency checkpoint and surface PreemptionError.
+        j = job
+        if j is not None:
+            try:
+                j.request_preemption()
+            except Exception:
+                pass
+
+    from raydp_tpu.control import get_arbiter as _get_arbiter
+
+    arb = _get_arbiter()
+    lease = None
     try:
+        # Control-plane admission: the whole supervised fit holds ONE
+        # gang lease across restarts. Blocks in the admission queue
+        # when the cluster is full; raises ClusterBusyError on shed or
+        # admission timeout; inert no-op when the arbiter is disabled.
+        lease = arb.acquire(
+            fit_job, slots=world_size, kind="gang", label="fit-spmd",
+            on_preempt=_preempt_gang,
+        )
         while True:
+            if not lease.active:
+                # Preempted last attempt: the drain released the lease
+                # (freeing the slots to the higher-priority arrival) —
+                # re-enter admission behind it and resume from the
+                # emergency checkpoint once capacity returns. The
+                # arbiter emits sched/resume on this grant.
+                lease = arb.acquire(
+                    fit_job, slots=cur_world, kind="gang",
+                    label="fit-spmd", on_preempt=_preempt_gang,
+                )
+            lease.renew()
             ds = _resharded(cur_world)
             resume = _newest_checkpoint(checkpoint_dir)
             if restarts and resume is not None:
@@ -317,6 +352,11 @@ def fit_spmd(
                         "preempt/request", attempt=restarts,
                         world_size=cur_world,
                     )
+                    # Yield capacity NOW: the drain is durable (the
+                    # emergency checkpoint committed before the rank
+                    # raised), so the slots go to whoever the arbiter
+                    # queued; this fit re-enters admission above.
+                    lease.release(state="drained")
                 _flight.record(
                     "supervisor", "gang_failed", attempt=restarts,
                     world_size=cur_world, preempted=preempted,
@@ -351,6 +391,9 @@ def fit_spmd(
                             to_world=got, attempt=restarts,
                         )
                         cur_world = got
+                        # Elastic shrink returns the departed hosts'
+                        # slots to the queue.
+                        lease.resize(cur_world)
                 delay = restart_backoff_s * (2 ** (restarts - 1))
                 delay *= 1.0 + random.uniform(0.0, 0.25)  # decorrelate
                 logger.warning(
@@ -372,6 +415,15 @@ def fit_spmd(
         if job is not None:
             try:
                 job.stop()
+            except Exception:
+                pass
+        # Capacity must never leak: budget exhaustion, success, and
+        # user exceptions all return the slots so queued tenants are
+        # admitted instead of hanging (Lease.release is idempotent and
+        # a no-op for a lease already drained by preemption).
+        if lease is not None:
+            try:
+                lease.release()
             except Exception:
                 pass
         _scope.__exit__(None, None, None)

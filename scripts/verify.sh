@@ -225,6 +225,119 @@ for kind in ("shuffle_bytes", "chip_seconds"):
 PYEOF
   rm -rf "$acct_dir"
 fi
+# Scheduler smoke (HARD): with the arbiter enabled (capacity 1), a
+# high-priority arrival must preempt the running low-priority gang,
+# which drains to a step_emergency_* checkpoint and releases its
+# slot; the arrival completes untouched, the victim auto-resumes and
+# lands on the SAME loss as an unpreempted run (exact-position resume
+# — replay bounded by one save_every_steps interval), and the
+# event-timeline CLI renders the preempt->resume MTTR episode — the
+# end-to-end proof of doc/scheduling.md's preemption story.
+if [ "$rc" -eq 0 ]; then
+  echo "--- scheduler smoke (priority preemption) ---"
+  sched_dir=$(mktemp -d)
+  JAX_PLATFORMS=cpu RAYDP_TPU_TELEMETRY_DIR="$sched_dir" python - <<'PYEOF' \
+    && JAX_PLATFORMS=cpu python -m raydp_tpu.telemetry.events "$sched_dir" \
+         | grep -q "sched/preempt -> sched/resume" \
+    && echo "SCHED_SMOKE=ok" || { echo "SCHED_SMOKE=failed"; rc=1; }
+import glob
+import os
+import tempfile
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+
+import raydp_tpu.dataframe as rdf
+from raydp_tpu import control, telemetry
+from raydp_tpu.data import MLDataset
+from raydp_tpu.train.spmd_fit import fit_spmd
+
+
+def factory_builder(ckpt, num_epochs, save_every=0):
+    def make_estimator():
+        import jax
+        import optax
+
+        from raydp_tpu.models import MLP
+        from raydp_tpu.parallel import MeshSpec
+        from raydp_tpu.train import JAXEstimator
+
+        return JAXEstimator(
+            model=MLP(hidden=(16,), out_dim=1), optimizer=optax.adam(3e-2),
+            loss="mse", num_epochs=num_epochs, batch_size=128,
+            feature_columns=["a", "b"], label_column="y",
+            mesh=MeshSpec(dp=len(jax.devices())), seed=0, shuffle=False,
+            epoch_mode="stream", checkpoint_dir=ckpt,
+            save_every_steps=save_every,
+        )
+
+    return make_estimator
+
+
+def dataset(n):
+    rng = np.random.default_rng(0)
+    a, b = rng.standard_normal(n), rng.standard_normal(n)
+    pdf = pd.DataFrame({"a": a, "b": b, "y": 2 * a - 3 * b + 1})
+    return MLDataset.from_df(rdf.from_pandas(pdf, num_partitions=2),
+                             num_shards=1)
+
+
+ds = dataset(4096)
+arrival_ds = dataset(512)  # materialized up front: no ETL in the race
+# Retention off for the victim so the emergency ckpt survives to the
+# end of the (checkpoint-heavy) run for the glob assert below.
+env = {"JAX_PLATFORMS": "cpu", "RAYDP_TPU_CKPT_KEEP": "0"}
+root = tempfile.mkdtemp()
+clean = fit_spmd(
+    factory_builder(os.path.join(root, "clean"), 8, save_every=2), ds,
+    world_size=1, env=env, timeout=300,
+)
+
+control.configure(capacity=1, admit_timeout_s=240.0)
+victim_dir = os.path.join(root, "victim")
+victim_out = {}
+
+
+def run_victim():
+    with telemetry.job_scope(telemetry.mint_job("victim", priority=0)):
+        victim_out["res"] = fit_spmd(
+            factory_builder(victim_dir, 8, save_every=2), ds,
+            world_size=1, env=env, timeout=300, checkpoint_dir=victim_dir,
+        )
+
+
+vt = threading.Thread(target=run_victim, daemon=True)
+vt.start()
+# Arrival goes in only once the victim is visibly mid-epoch (first
+# periodic checkpoint committed): the preemption must exercise the
+# drain, not a startup race.
+deadline = time.monotonic() + 240.0
+mid = os.path.join(victim_dir, "step_mid_2", "_METADATA")
+while time.monotonic() < deadline and not os.path.isfile(mid):
+    time.sleep(0.05)
+assert os.path.isfile(mid), "victim never reached its first mid ckpt"
+
+with telemetry.job_scope(telemetry.mint_job("arrival", priority=5)):
+    arrival = fit_spmd(
+        factory_builder(None, 1), arrival_ds, world_size=1,
+        env={"JAX_PLATFORMS": "cpu"}, timeout=300,
+    )
+vt.join(300.0)
+victim = victim_out["res"]
+
+assert arrival["restarts"] == 0, arrival["restarts"]
+assert victim["restarts"] == 1, victim["restarts"]
+assert glob.glob(os.path.join(victim_dir, "step_emergency_*")), \
+    "preemption did not drain an emergency checkpoint"
+np.testing.assert_allclose(
+    victim["history"][-1]["train_loss"],
+    clean["history"][-1]["train_loss"], rtol=1e-4,
+)
+PYEOF
+  rm -rf "$sched_dir"
+fi
 # Bench regression gate (ADVISORY): when two result files exist, diff
 # the newest pair; a >10% throughput/MFU regression prints loudly but
 # never fails the tier-1 gate (bench noise on shared CI boxes is real

@@ -58,6 +58,46 @@ def test_plan_parses_every_kind():
     assert all(c.armed for c in clauses)
 
 
+def test_plan_job_targeting():
+    """job= targets a tenant by name or minted id; it also satisfies
+    the rank=/worker= requirement (a job-wide kill needs no rank)."""
+    clauses = parse_plan(
+        "preempt:job=tenant-a,step=4,grace=0;"
+        "kill:job=tenant-b,step=7;"
+        "kill:job=tenant-b,task=2;"
+        "kill:job=tenant-b,rank=1,step=9"
+    )
+    pre, kill_step, kill_task, kill_both = clauses
+    assert pre.job == "tenant-a" and pre.step == 4
+    assert kill_step.job == "tenant-b" and kill_step.rank is None
+    assert kill_task.task == 2 and kill_task.worker is None
+    assert kill_both.rank == 1  # job= composes with rank=
+    assert pre.matches_job("job-123", "tenant-a")
+    assert pre.matches_job("tenant-a", None)
+    assert not pre.matches_job("job-1", "tenant-b")
+    # job= with no ambient job never matches: a targeted clause must
+    # not fire in unattributed work
+    assert not pre.matches_job(None, None)
+    # untargeted clauses match everything, including no job at all
+    untargeted = parse_plan("preempt:step=1")[0]
+    assert untargeted.matches_job(None, None)
+    assert untargeted.matches_job("j", "n")
+
+
+def test_job_targeted_clause_fires_only_in_matching_scope(monkeypatch):
+    from raydp_tpu.telemetry import accounting as acct
+
+    monkeypatch.setenv(
+        "RAYDP_TPU_FAULT_PLAN", "preempt:job=tenant-a,step=2,grace=0"
+    )
+    with acct.job_scope(acct.mint_job("tenant-b")):
+        fault.on_train_step(2)
+    assert not fault.preemption_requested()
+    with acct.job_scope(acct.mint_job("tenant-a")):
+        fault.on_train_step(2)
+    assert fault.preemption_requested()
+
+
 @pytest.mark.parametrize("bad", [
     "explode:rank=1",                      # unknown kind
     "kill:rank=1",                         # kill needs step= or task=
@@ -341,6 +381,46 @@ def test_checkpoint_records_world_and_rescales_resume(tmp_path, monkeypatch):
     # the rescale itself happens in _fit: saved_world=2, cur=1 -> the
     # 3 per-rank batches of the dead world are 6 batches here
     assert int(round(3 * 2 / jax.process_count())) == 6
+
+
+def test_checkpoint_retention_prunes_oldest_resume_survives(
+    tmp_path, monkeypatch
+):
+    """RAYDP_TPU_CKPT_KEEP bounds the step_mid_*/step_emergency_* ring:
+    a long run prunes oldest-first after each save, never the newest
+    complete checkpoint (resume-after-prune must work) and never
+    epoch-end checkpoints."""
+    import glob as _glob
+
+    monkeypatch.setenv("RAYDP_TPU_CKPT_KEEP", "2")
+    ds = _ds(shards=1)
+    ckpt = str(tmp_path)
+    est = _factory(ckpt, num_epochs=2, save_every_steps=2)()
+    est.fit(ds)  # 16 steps -> 8 mid saves, retention keeps the last 2
+    mids = sorted(
+        os.path.basename(p)
+        for p in _glob.glob(os.path.join(ckpt, "step_mid_*"))
+    )
+    assert mids == ["step_mid_14", "step_mid_16"]
+    # epoch-end checkpoints are durable artifacts, not part of the ring
+    assert os.path.isdir(os.path.join(ckpt, "step_0"))
+    assert os.path.isdir(os.path.join(ckpt, "step_1"))
+
+    from raydp_tpu.telemetry import events as _events_mod
+
+    kinds = [r["name"] for r in _events_mod.local_events()]
+    assert "checkpoint/prune" in kinds
+
+    # regression: the survivor restores into a fresh estimator
+    fresh = _factory(None)()
+    fresh.restore_path(
+        os.path.join(ckpt, "step_mid_16"),
+        sample_x=np.zeros((1, 2), np.float32),
+    )
+    for a, b in zip(
+        _leaves(est._state.params), _leaves(fresh._state.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
 
 
 def test_fit_spmd_restart_budget_exhausts(tmp_path):

@@ -1,0 +1,446 @@
+"""Multi-tenant control plane (doc/scheduling.md).
+
+Unit coverage of the ClusterArbiter state machine — priority-ordered
+admission, DWRR fair-share tie-breaks, load shedding, lease TTL /
+preempt-deadline reclaim, ETL turn reentrancy — plus two end-to-end
+tenancy tests: a scheduler-driven preemption whose victim resumes to
+loss parity with an unpreempted run, and a restart-budget exhaustion
+that sheds capacity back to queued work instead of hanging it.
+"""
+import glob
+import os
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import raydp_tpu.dataframe as rdf
+from raydp_tpu import control
+from raydp_tpu.control import ClusterBusyError, stage_gate
+from raydp_tpu.data import MLDataset
+from raydp_tpu.telemetry import accounting as acct
+from raydp_tpu.telemetry import events as events_mod
+from raydp_tpu.train.spmd_fit import fit_spmd
+from raydp_tpu.utils.profiling import metrics as _metrics
+
+
+@pytest.fixture(autouse=True)
+def _clean_arbiter(monkeypatch):
+    for var in (
+        control.SCHED_CAPACITY_ENV,
+        control.SCHED_MAX_QUEUE_ENV,
+        control.SCHED_ADMIT_TIMEOUT_ENV,
+        control.SCHED_LEASE_TTL_ENV,
+        control.SCHED_PREEMPT_TIMEOUT_ENV,
+        control.SCHED_PRESSURE_ENV,
+    ):
+        monkeypatch.delenv(var, raising=False)
+    control.reset_for_tests()
+    yield
+    control.reset_for_tests()
+
+
+def _counter(name):
+    return _metrics.snapshot().get("counters", {}).get(name, 0)
+
+
+def _acquire_in_thread(arb, job, out, key, **kwargs):
+    def run():
+        try:
+            out[key] = arb.acquire(job, **kwargs)
+        except Exception as exc:  # noqa: BLE001 - recorded for asserts
+            out[key] = exc
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t
+
+
+def _wait_for(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return pred()
+
+
+# ------------------------------------------------------------------- units
+
+
+def test_disabled_arbiter_is_inert():
+    arb = control.get_arbiter()
+    assert not arb.enabled
+    lease = arb.acquire(acct.mint_job("t"), slots=999)
+    assert lease.inert
+    lease.release()  # no-op
+    with stage_gate("noop"):
+        pass
+    assert arb.report()["enabled"] is False
+
+
+def test_priority_orders_admission():
+    arb = control.configure(capacity=1, admit_timeout_s=10.0)
+    lo = acct.mint_job("lo", priority=0)
+    hi = acct.mint_job("hi", priority=5)
+    holder = arb.acquire(acct.mint_job("holder"), slots=1, preemptible=False)
+    out = {}
+    t_lo = _acquire_in_thread(arb, lo, out, "lo", slots=1, preemptible=False)
+    assert _wait_for(lambda: arb.report()["queue_depth"] == 1)
+    t_hi = _acquire_in_thread(arb, hi, out, "hi", slots=1, preemptible=False)
+    assert _wait_for(lambda: arb.report()["queue_depth"] == 2)
+    # grant order is priority-first even though lo enqueued first
+    assert [w["job"] for w in arb.report()["queue"]] == [hi.job_id, lo.job_id]
+    holder.release()
+    t_hi.join(5.0)
+    assert not isinstance(out.get("hi"), Exception) and "hi" in out
+    assert "lo" not in out  # still queued behind hi's lease
+    out["hi"].release()
+    t_lo.join(5.0)
+    assert "lo" in out and not isinstance(out["lo"], Exception)
+    out["lo"].release()
+
+
+def test_dwrr_deficit_breaks_priority_ties():
+    arb = control.configure(capacity=1, admit_timeout_s=10.0)
+    heavy = acct.mint_job("heavy", priority=1)
+    light = acct.mint_job("light", priority=1)
+    # The usage ledger is the DWRR input: bill real consumption to one
+    # of the two equal-priority tenants, the other is behind its fair
+    # share and must grant first regardless of enqueue order.
+    with acct.job_scope(heavy):
+        acct.add_usage("task_seconds", 500.0)
+    holder = arb.acquire(acct.mint_job("holder"), slots=1, preemptible=False)
+    out = {}
+    t_heavy = _acquire_in_thread(
+        arb, heavy, out, "heavy", slots=1, preemptible=False
+    )
+    assert _wait_for(lambda: arb.report()["queue_depth"] == 1)
+    t_light = _acquire_in_thread(
+        arb, light, out, "light", slots=1, preemptible=False
+    )
+    assert _wait_for(lambda: arb.report()["queue_depth"] == 2)
+    assert [w["job"] for w in arb.report()["queue"]] == [
+        light.job_id, heavy.job_id
+    ]
+    holder.release()
+    t_light.join(5.0)
+    assert "light" in out and "heavy" not in out
+    out["light"].release()
+    t_heavy.join(5.0)
+    out["heavy"].release()
+
+
+def test_shed_on_max_queue_carries_depth_and_eta():
+    arb = control.configure(capacity=1, max_queue=1, admit_timeout_s=5.0)
+    holder = arb.acquire(acct.mint_job("holder"), slots=1, preemptible=False)
+    out = {}
+    _acquire_in_thread(
+        arb, acct.mint_job("queued"), out, "q", slots=1, preemptible=False,
+        timeout=5.0,
+    )
+    assert _wait_for(lambda: arb.report()["queue_depth"] == 1)
+    before = _counter("sched/sheds")
+    with pytest.raises(ClusterBusyError) as exc_info:
+        arb.acquire(acct.mint_job("shed-me"), slots=1)
+    assert exc_info.value.queue_depth >= 1
+    assert _counter("sched/sheds") == before + 1
+    kinds = [r["name"] for r in events_mod.local_events()]
+    assert "sched/shed" in kinds
+    holder.release()
+
+
+def test_admission_timeout_raises_busy():
+    arb = control.configure(capacity=1, admit_timeout_s=0.2)
+    holder = arb.acquire(acct.mint_job("holder"), slots=1, preemptible=False)
+    t0 = time.monotonic()
+    with pytest.raises(ClusterBusyError):
+        arb.acquire(acct.mint_job("late"), slots=1, timeout=0.2)
+    assert time.monotonic() - t0 < 5.0
+    holder.release()
+
+
+def test_oversized_request_is_rejected_not_queued_forever():
+    arb = control.configure(capacity=2, admit_timeout_s=0.3)
+    with pytest.raises(ValueError, match="capacity"):
+        arb.acquire(acct.mint_job("whale"), slots=3, timeout=0.3)
+
+
+def test_preempts_lower_priority_victim_and_resumes():
+    arb = control.configure(capacity=1, admit_timeout_s=10.0)
+    lo = acct.mint_job("victim", priority=0)
+    hi = acct.mint_job("arrival", priority=5)
+    drained = threading.Event()
+    victim = arb.acquire(lo, slots=1, kind="gang", label="victim-gang")
+    victim.bind_preempt(drained.set)
+    before = _counter("sched/preemptions/priority")
+    out = {}
+    t = _acquire_in_thread(arb, hi, out, "hi", slots=1, kind="gang")
+    assert drained.wait(5.0), "scheduler never requested preemption"
+    assert arb.report()["states"][lo.job_id] == "preempting"
+    victim.release(state="drained")  # emergency checkpoint committed
+    t.join(5.0)
+    assert "hi" in out and not isinstance(out["hi"], Exception)
+    assert _counter("sched/preemptions/priority") == before + 1
+    assert arb.report()["states"][lo.job_id] == "drained"
+    # the victim's next grant is a resume, behind the arrival
+    out2 = {}
+    t2 = _acquire_in_thread(arb, lo, out2, "resume", slots=1, kind="gang")
+    time.sleep(0.2)
+    assert "resume" not in out2
+    out["hi"].release()
+    t2.join(5.0)
+    assert "resume" in out2 and not isinstance(out2["resume"], Exception)
+    out2["resume"].release()
+    kinds = [r["name"] for r in events_mod.local_events()]
+    assert "sched/preempt" in kinds and "sched/resume" in kinds
+
+
+def test_preempt_deadline_reclaims_hung_victim():
+    arb = control.configure(
+        capacity=1, admit_timeout_s=10.0, preempt_timeout_s=0.2
+    )
+    hung = arb.acquire(
+        acct.mint_job("hung", priority=0), slots=1, kind="gang",
+        on_preempt=lambda: None,  # never drains
+    )
+    before = _counter("sched/preemptions/lease_timeout")
+    out = {}
+    t = _acquire_in_thread(
+        arb, acct.mint_job("arrival", priority=5), out, "hi", slots=1,
+        kind="gang",
+    )
+    t.join(10.0)
+    assert "hi" in out and not isinstance(out["hi"], Exception)
+    assert not hung.active  # force-reclaimed by the preempt deadline
+    assert _counter("sched/preemptions/lease_timeout") == before + 1
+    out["hi"].release()
+
+
+def test_lease_ttl_reclaims_unrenewed_lease():
+    arb = control.configure(
+        capacity=1, admit_timeout_s=10.0, lease_ttl_s=0.2
+    )
+    stale = arb.acquire(acct.mint_job("stale"), slots=1, preemptible=False)
+    time.sleep(0.3)  # past TTL with no renew()
+    got = arb.acquire(acct.mint_job("next"), slots=1, timeout=5.0)
+    assert not stale.active
+    got.release()
+
+
+def test_stage_gate_turns_are_reentrant_and_leaseholder_passthrough():
+    arb = control.configure(capacity=1, admit_timeout_s=5.0)
+    job = acct.mint_job("etl")
+    with acct.job_scope(job):
+        with stage_gate("outer"):
+            assert arb.in_use() == 1
+            with stage_gate("inner"):  # reentrant: no second turn
+                assert arb.in_use() == 1
+    assert arb.in_use() == 0
+    # a gang leaseholder's own ETL must not queue behind its gang
+    gang = arb.acquire(job, slots=1, kind="gang")
+    with acct.job_scope(job):
+        with stage_gate("own-etl"):
+            assert arb.in_use() == 1  # pass-through, no extra turn
+    gang.release()
+
+
+def test_scheduler_report_shape_and_cluster_delegation():
+    arb = control.configure(capacity=4, admit_timeout_s=5.0)
+    lease = arb.acquire(acct.mint_job("j"), slots=3, kind="gang", label="g")
+    rep = arb.report()
+    assert rep["enabled"] and rep["capacity"] == 4 and rep["in_use"] == 3
+    assert rep["queue_depth"] == 0 and rep["queue"] == []
+    (entry,) = rep["leases"]
+    assert entry["slots"] == 3 and entry["kind"] == "gang"
+    assert "wait_p50_s" in rep and "eta_s" in rep and "states" in rep
+    lease.release()
+    assert arb.report()["in_use"] == 0
+
+
+def test_elastic_resize_returns_slots():
+    arb = control.configure(capacity=4, admit_timeout_s=5.0)
+    lease = arb.acquire(acct.mint_job("gang"), slots=4, kind="gang")
+    out = {}
+    t = _acquire_in_thread(
+        arb, acct.mint_job("small"), out, "s", slots=2, preemptible=False
+    )
+    assert _wait_for(lambda: arb.report()["queue_depth"] == 1)
+    lease.resize(2)  # elastic shrink: 2 slots back to the queue
+    t.join(5.0)
+    assert "s" in out and not isinstance(out["s"], Exception)
+    out["s"].release()
+    lease.release()
+
+
+# -------------------------------------------------- end-to-end tenancy
+
+
+CPU_ENV = {"JAX_PLATFORMS": "cpu"}
+
+
+def _factory(ckpt_dir=None, num_epochs=2, save_every_steps=0):
+    def make_estimator():
+        import jax
+        import optax
+
+        from raydp_tpu.models import MLP
+        from raydp_tpu.parallel import MeshSpec
+        from raydp_tpu.train import JAXEstimator
+
+        return JAXEstimator(
+            model=MLP(hidden=(16,), out_dim=1),
+            optimizer=optax.adam(3e-2),
+            loss="mse",
+            num_epochs=num_epochs,
+            batch_size=128,
+            feature_columns=["a", "b"],
+            label_column="y",
+            mesh=MeshSpec(dp=len(jax.devices())),
+            seed=0,
+            shuffle=False,
+            epoch_mode="stream",
+            checkpoint_dir=ckpt_dir,
+            save_every_steps=save_every_steps,
+        )
+
+    return make_estimator
+
+
+def _ds(n=1024, shards=1):
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal(n)
+    b = rng.standard_normal(n)
+    y = 2 * a - 3 * b + 1
+    pdf = pd.DataFrame({"a": a, "b": b, "y": y})
+    df = rdf.from_pandas(pdf, num_partitions=shards * 2)
+    return MLDataset.from_df(df, num_shards=shards)
+
+
+def _leaves(tree):
+    import jax
+
+    return jax.tree_util.tree_leaves(tree)
+
+
+@pytest.mark.slow  # ~30s of gang fits; verify.sh SCHED_SMOKE is the
+# tier-1 gate for this exact scenario (same asserts + events CLI).
+def test_two_tenants_preempt_resume_loss_parity(tmp_path):
+    """Scheduler-driven preemption end-to-end on one cluster: a
+    high-priority arrival evicts the low-priority gang mid-epoch via
+    the SIGTERM drain path, trains on the freed slot, and the victim
+    auto-resumes from its emergency checkpoint to the SAME final
+    params/loss as an unpreempted run (exact-position resume, same
+    data order, same rng chain)."""
+    ds = _ds(n=4096)
+    # Long victim run (8 epochs, a checkpoint every 2 steps) so the
+    # arrival lands mid-training with plenty of runway, not in a race
+    # against the victim's natural completion. The arrival's dataset is
+    # materialized up front: its ETL must not sit between detecting the
+    # victim's first checkpoint and the preempting acquire.
+    arrival_ds = _ds(n=512)
+    victim_env = {**CPU_ENV, "RAYDP_TPU_CKPT_KEEP": "0"}
+    clean = fit_spmd(
+        _factory(str(tmp_path / "clean"), num_epochs=8,
+                 save_every_steps=2), ds,
+        world_size=1, env=victim_env, timeout=300,
+    )
+
+    control.configure(capacity=1, admit_timeout_s=240.0)
+    victim_dir = str(tmp_path / "victim")
+    victim_out = {}
+
+    def run_victim():
+        with acct.job_scope(acct.mint_job("victim", priority=0)):
+            try:
+                victim_out["res"] = fit_spmd(
+                    _factory(victim_dir, num_epochs=8,
+                             save_every_steps=2), ds,
+                    world_size=1, env=victim_env, timeout=300,
+                    checkpoint_dir=victim_dir,
+                )
+            except Exception as exc:  # noqa: BLE001 - recorded for asserts
+                victim_out["err"] = exc
+
+    vt = threading.Thread(target=run_victim, daemon=True)
+    vt.start()
+    # Inject the arrival only once the victim is visibly mid-epoch (its
+    # first periodic checkpoint committed) so the preemption exercises
+    # the drain, not a startup race.
+    assert _wait_for(
+        lambda: os.path.isfile(
+            os.path.join(victim_dir, "step_mid_2", "_METADATA")
+        ),
+        timeout=240.0,
+    ), "victim never reached its first mid checkpoint"
+
+    with acct.job_scope(acct.mint_job("arrival", priority=5)):
+        arrival = fit_spmd(
+            _factory(None, num_epochs=1), arrival_ds, world_size=1,
+            env=CPU_ENV, timeout=300,
+        )
+    vt.join(300.0)
+    assert "err" not in victim_out, victim_out.get("err")
+    assert "res" in victim_out, "victim did not finish after resume"
+    victim = victim_out["res"]
+
+    assert arrival["restarts"] == 0
+    assert victim["restarts"] == 1
+    assert glob.glob(os.path.join(victim_dir, "step_emergency_*")), (
+        "preemption did not drain an emergency checkpoint"
+    )
+    np.testing.assert_allclose(
+        victim["history"][-1]["train_loss"],
+        clean["history"][-1]["train_loss"],
+        rtol=1e-4,
+    )
+    for a, b in zip(_leaves(clean["params"]), _leaves(victim["params"])):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7)
+    kinds = [r["name"] for r in events_mod.local_events()]
+    assert "sched/preempt" in kinds and "sched/resume" in kinds
+
+
+def test_budget_exhaustion_sheds_capacity_to_queued_work(tmp_path):
+    """A tenant whose gang burns its whole restart budget must release
+    its slots on the way out: the queued tenant is admitted and
+    completes instead of hanging behind a dead job."""
+    from raydp_tpu.spmd.job import SPMDJobError
+
+    control.configure(capacity=1, admit_timeout_s=240.0)
+    ds = _ds(n=512)
+    doomed_out = {}
+
+    def run_doomed():
+        with acct.job_scope(acct.mint_job("doomed", priority=5)):
+            try:
+                fit_spmd(
+                    _factory(None, num_epochs=1), ds, world_size=1,
+                    env={
+                        **CPU_ENV,
+                        # re-fires every incarnation: step 1 is never
+                        # behind a checkpoint
+                        "RAYDP_TPU_FAULT_PLAN": "kill:rank=0,step=1",
+                    },
+                    timeout=300, max_restarts=1, restart_backoff_s=0.1,
+                )
+            except SPMDJobError as exc:
+                doomed_out["err"] = exc
+
+    dt = threading.Thread(target=run_doomed, daemon=True)
+    dt.start()
+    arb = control.get_arbiter()
+    assert _wait_for(lambda: arb.in_use() == 1, timeout=60.0)
+    # lower priority than the doomed job: never preempts it, just queues
+    with acct.job_scope(acct.mint_job("patient", priority=0)):
+        patient = fit_spmd(
+            _factory(None, num_epochs=1), ds, world_size=1, env=CPU_ENV,
+            timeout=300,
+        )
+    dt.join(60.0)
+    assert "err" in doomed_out
+    assert "restart budget exhausted" in str(doomed_out["err"])
+    assert patient["restarts"] == 0
+    assert np.isfinite(patient["history"][-1]["train_loss"])
+    assert arb.in_use() == 0  # no leaked capacity
