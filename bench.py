@@ -2171,6 +2171,73 @@ def _capture_gang_profile() -> dict:
         job.stop()
 
 
+def bench_serving():
+    """Serving-plane evidence (doc/serving.md): the same replica group
+    driven with continuous batching vs a naive one-request-per-dispatch
+    loop (``max_batch=1``). The model charges a fixed ~4 ms per
+    ``ExecuteBatch``, so the batched/naive throughput ratio isolates
+    what batch assembly buys; p50/p99 and batch fill come from the
+    group's own stats surface. Result parity across both arms is the
+    correctness gate."""
+    from raydp_tpu import control
+    from raydp_tpu.serve import ReplicaGroup
+    from raydp_tpu.utils.profiling import metrics as _metrics
+
+    n_requests = 192
+    control.reset_for_tests()  # serving admits through the arbiter
+
+    def make_model():
+        # Nested so cloudpickle ships it by value to the replica procs.
+        def model(payloads, bucket):
+            time.sleep(0.004)
+            return [float(sum(p)) for p in payloads]
+
+        return model
+
+    def drive(max_batch, label):
+        _metrics.reset()  # stats() reads the process-global registry
+        with ReplicaGroup(
+            replicas=2, model_fn=make_model(), label=label,
+            max_batch=max_batch, slo_ms=20, max_queue=n_requests + 8,
+            restart_backoff_s=0.2,
+        ).start() as group:
+            # start() returns while the replica interpreters are still
+            # booting; wait them out so both arms time steady-state
+            # serving, not process startup.
+            boot_deadline = time.monotonic() + 30.0
+            while group.stats()["replicas_alive"] < 2:
+                if time.monotonic() >= boot_deadline:
+                    raise RuntimeError(
+                        f"serving bench ({label}): replicas never came up"
+                    )
+                time.sleep(0.02)
+            group.predict([0] * 8, timeout_s=30.0)  # warm dispatch path
+            t0 = time.perf_counter()
+            reqs = [group.submit([i % 7] * 8, timeout_s=180.0)
+                    for i in range(n_requests)]
+            results = [r.wait(timeout=180.0) for r in reqs]
+            wall = time.perf_counter() - t0
+            expect = [float((i % 7) * 8) for i in range(n_requests)]
+            if results != expect:
+                raise RuntimeError(
+                    f"serving bench ({label}): replies diverged"
+                )
+            stats = group.stats()
+        return wall, stats
+
+    batched_wall, batched = drive(8, "bench-serve-batched")
+    naive_wall, _ = drive(1, "bench-serve-naive")
+    return {
+        "requests": n_requests,
+        "requests_per_sec": round(n_requests / batched_wall, 2),
+        "latency_p50_ms": round(batched["latency_p50_s"] * 1e3, 3),
+        "latency_p99_ms": round(batched["latency_p99_s"] * 1e3, 3),
+        "batch_fill": batched["batch_fill"],
+        "naive_requests_per_sec": round(n_requests / naive_wall, 2),
+        "speedup_vs_naive": round(naive_wall / batched_wall, 2),
+    }
+
+
 # ----------------------------------------------------------- main
 
 # The CPU matrix runs in THIS process (pinned to the CPU platform —
@@ -2198,6 +2265,9 @@ CPU_MATRIX = [
     # Multi-tenant control plane: fair-share turn split, scheduler
     # preemption MTTR, queue-wait p50 (doc/scheduling.md).
     ("multi_tenant", bench_multi_tenant),
+    # Serving plane: continuous batching vs naive per-request dispatch
+    # over real replica processes (doc/serving.md).
+    ("serving", bench_serving),
     # Ingest is bandwidth-sensitive: keep it ahead of the model configs
     # that leave host-memory pressure behind.
     ("ingest_device_feed", bench_ingest),

@@ -26,6 +26,7 @@ import os
 import random
 import threading
 import time
+import uuid
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Callable, Dict, List, Optional
 
@@ -381,10 +382,17 @@ class RemoteCluster:
         resolve them through the normal data plane) and only refs ride
         the per-task envelope."""
         staged = self._stage_data_args(data_args)
+        # One id for ALL delivery attempts of this submission: a
+        # reconnect retry after UNAVAILABLE may land on a worker that
+        # already executed (or is still executing) the first delivery —
+        # the worker-side dedup cache keyed on this id turns the
+        # re-delivery into a wait-for-the-original instead of a second
+        # execution (serve dispatches are not idempotent).
         payload = {
             "fn": cloudpickle.dumps(fn),
             "args": args,
             "kwargs": kwargs,
+            "request_id": uuid.uuid4().hex,
         }
         if staged:
             payload["data_refs"] = staged

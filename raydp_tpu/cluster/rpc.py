@@ -51,7 +51,8 @@ def _identity(b: bytes) -> bytes:
 # the requested capture window) and so legitimately outlive the default
 # stall threshold; everything else is control-plane and fast.
 _LONG_HANDLER_METHODS = frozenset(
-    {"RunTask", "RunTaskBatch", "RunFunction", "ProfileRequest"}
+    {"RunTask", "RunTaskBatch", "RunFunction", "ProfileRequest",
+     "ExecuteBatch"}
 )
 
 

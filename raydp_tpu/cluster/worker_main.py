@@ -21,6 +21,7 @@ import sys
 import threading
 import time
 import traceback
+from collections import OrderedDict
 
 import cloudpickle
 
@@ -39,6 +40,12 @@ logger = logging.getLogger(__name__)
 
 WORKER_SERVICE = "raydp.Worker"
 REGISTER_RETRIES = 3
+# Completed-task replies kept for duplicate-delivery detection. Sized
+# for the realistic retry window (seconds), not task history.
+_DEDUP_CAPACITY = 1024
+# A duplicate that arrives while the original is still executing waits
+# this long for the first execution to finish before giving up.
+_DEDUP_WAIT_S = 300.0
 
 
 class WorkerContext:
@@ -114,6 +121,14 @@ class Worker:
         # batched alike) — the index the fault plan's kill task= clause
         # matches against.
         self._task_seq = 0
+        # At-most-once execution for id-carrying tasks: request_id ->
+        # {"done": Event, "reply": dict | None, "error": str | None}.
+        # A client reconnect retry that re-delivers an envelope this
+        # process already saw waits for (or returns) the first
+        # execution's outcome instead of running the fn twice. Bounded:
+        # oldest entries age out past _DEDUP_CAPACITY.
+        self._dedup: "OrderedDict[str, dict]" = OrderedDict()
+        self._dedup_lock = threading.Lock()
         # Telemetry: each heartbeat carries the registry sections that
         # changed since the previous beat (delta-encoded snapshot).
         self._shipper = MetricsShipper()
@@ -178,6 +193,45 @@ class Worker:
         )
 
     def _on_run_task(self, req: dict) -> dict:
+        rid = req.get("request_id")
+        if rid is None:
+            return self._execute_task(req)
+        with self._dedup_lock:
+            entry = self._dedup.get(rid)
+            owner = entry is None
+            if owner:
+                entry = {
+                    "done": threading.Event(), "reply": None, "error": None,
+                }
+                self._dedup[rid] = entry
+                while len(self._dedup) > _DEDUP_CAPACITY:
+                    self._dedup.popitem(last=False)
+            else:
+                self._dedup.move_to_end(rid)
+        if not owner:
+            # Re-delivery of an envelope this process already has:
+            # return the first execution's outcome (waiting it out if
+            # still in flight) — never run the fn a second time.
+            metrics.counter_add("worker/dup_tasks")
+            if not entry["done"].wait(timeout=_DEDUP_WAIT_S):
+                raise RuntimeError(
+                    f"duplicate delivery of task {rid}: original "
+                    f"execution still in flight after {_DEDUP_WAIT_S:.0f}s"
+                )
+            if entry["error"] is not None:
+                raise RuntimeError(entry["error"])
+            return entry["reply"]
+        try:
+            reply = self._execute_task(req)
+        except Exception as exc:
+            entry["error"] = f"{type(exc).__name__}: {exc}"
+            entry["done"].set()
+            raise
+        entry["reply"] = reply
+        entry["done"].set()
+        return reply
+
+    def _execute_task(self, req: dict) -> dict:
         # Busy goes up FIRST: between this handler starting and fn
         # deserializing, the heartbeat thread must already see the task
         # — an exit decision in that setup window would cancel it.

@@ -14,6 +14,8 @@ Hook surface (all no-ops when no plan is configured):
 * :func:`on_task` — ETL worker task boundary (kill).
 * :func:`on_rpc` — RPC client send (delay / drop one call).
 * :func:`on_heartbeat` — heartbeat loops (skip beats).
+* :func:`on_serve_request` — serving replica request boundary
+  (serve_kill / latency).
 
 Preemption notices are first-class and independent of the plan: a real
 SIGTERM lands in the same :func:`preemption_requested` flag the
@@ -33,10 +35,12 @@ from raydp_tpu.fault.inject import (
     PreemptionError,
     active,
     ambient_rank,
+    ambient_replica,
     install_sigterm_drain,
     mark_drained,
     on_heartbeat,
     on_rpc,
+    on_serve_request,
     on_task,
     on_train_step,
     preemption_requested,
@@ -53,10 +57,12 @@ __all__ = [
     "PreemptionError",
     "active",
     "ambient_rank",
+    "ambient_replica",
     "install_sigterm_drain",
     "mark_drained",
     "on_heartbeat",
     "on_rpc",
+    "on_serve_request",
     "on_task",
     "on_train_step",
     "parse_plan",

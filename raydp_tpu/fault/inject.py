@@ -174,6 +174,60 @@ def on_task(worker_id: str, task_index: int) -> None:
                 _die(c, f"worker {worker_id} at task {task_index}")
 
 
+def ambient_replica() -> Optional[int]:
+    """The serving replica index of this process, if launched as one."""
+    raw = os.environ.get("RAYDP_SERVE_REPLICA")
+    if raw is None:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        return None
+
+
+def _ambient_incarnation() -> int:
+    """Restart count of this replica's lineage (0 = first spawn)."""
+    try:
+        return int(os.environ.get("RAYDP_SERVE_INCARNATION", "0"))
+    except ValueError:
+        return 0
+
+
+def on_serve_request(
+    request_index: int, replica: Optional[int] = None
+) -> None:
+    """Hook when a serving replica begins executing its
+    ``request_index``-th request (0-based, per process).
+
+    Fires ``serve_kill`` (hard-exit, first incarnation of the lineage
+    only — respawned replicas are not re-killed, so self-healing is
+    observable) and ``latency`` (in-place stall) clauses.
+    """
+    clauses = _clauses()
+    if not clauses:
+        return
+    if replica is None:
+        replica = ambient_replica()
+    for c in clauses:
+        if not c.armed or c.fired:
+            continue
+        if not c.matches_replica(replica):
+            continue
+        if c.kind == "serve_kill" and c.request == request_index:
+            if _ambient_incarnation() > 0:
+                continue
+            c.fired = True
+            _die(c, f"replica {replica} at request {request_index}")
+        elif c.kind == "latency" and c.nth == request_index:
+            c.fired = True
+            _emit_clause(
+                c,
+                f"replica {replica} stalled {c.delay}s "
+                f"at request {request_index}",
+            )
+            time.sleep(c.delay)
+
+
 def on_rpc(qualified_method: str) -> Optional[str]:
     """Hook before an RPC client sends ``Service.Method``.
 

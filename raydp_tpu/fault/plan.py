@@ -37,6 +37,18 @@ Kinds and their keys (see ``doc/fault_tolerance.md`` for semantics):
     loop of that process skips ``B`` consecutive beats starting at
     beat ``K`` (default 0), simulating a network partition long enough
     to trip liveness timeouts.
+``serve_kill``
+    ``replica=N,request=K[,code=C]`` — serving replica ``N`` hard-exits
+    with code ``C`` (default 23) when it begins executing its ``K``-th
+    request (0-based, counted per process). The clause targets the
+    lineage's *first* incarnation only: a respawned replica is not
+    re-killed, mirroring how a ``kill step=K`` fires once because the
+    resumed run skips past step ``K``.
+``latency``
+    ``nth=K,delay=S[,replica=N]`` — the ``K``-th request executed by a
+    serving replica (0-based, per process) stalls ``S`` seconds before
+    running, simulating a straggler batch; ``replica=N`` restricts the
+    stall to one replica.
 
 Any clause may carry ``prob=P`` (0..1): whether it arms is decided
 once, deterministically, from ``RAYDP_TPU_FAULT_SEED`` and the clause
@@ -52,12 +64,17 @@ from typing import Dict, List, Optional
 FAULT_PLAN_ENV = "RAYDP_TPU_FAULT_PLAN"
 FAULT_SEED_ENV = "RAYDP_TPU_FAULT_SEED"
 
-_KINDS = ("kill", "preempt", "rpc_delay", "rpc_drop", "hb_stall")
+_KINDS = (
+    "kill", "preempt", "rpc_delay", "rpc_drop", "hb_stall",
+    "serve_kill", "latency",
+)
 
 _REQUIRED: Dict[str, tuple] = {
     "rpc_delay": ("method", "nth", "delay"),
     "rpc_drop": ("method", "nth"),
     "hb_stall": ("beats",),
+    "serve_kill": ("replica", "request"),
+    "latency": ("nth", "delay"),
 }
 
 _ALLOWED: Dict[str, tuple] = {
@@ -66,9 +83,14 @@ _ALLOWED: Dict[str, tuple] = {
     "rpc_delay": ("method", "nth", "delay", "prob"),
     "rpc_drop": ("method", "nth", "prob"),
     "hb_stall": ("rank", "worker", "beats", "after", "prob"),
+    "serve_kill": ("replica", "request", "code", "prob"),
+    "latency": ("nth", "delay", "replica", "prob"),
 }
 
-_INT_KEYS = ("rank", "step", "task", "code", "nth", "beats", "after")
+_INT_KEYS = (
+    "rank", "step", "task", "code", "nth", "beats", "after",
+    "replica", "request",
+)
 _FLOAT_KEYS = ("delay", "grace", "prob")
 
 
@@ -89,6 +111,8 @@ class FaultClause:
     code: int = 23
     method: Optional[str] = None
     nth: Optional[int] = None
+    replica: Optional[int] = None
+    request: Optional[int] = None
     delay: float = 0.0
     grace: Optional[float] = None
     beats: int = 0
@@ -99,6 +123,11 @@ class FaultClause:
 
     def matches_rank(self, rank: Optional[int]) -> bool:
         return self.rank is None or (rank is not None and rank == self.rank)
+
+    def matches_replica(self, replica: Optional[int]) -> bool:
+        return self.replica is None or (
+            replica is not None and replica == self.replica
+        )
 
     def matches_worker(self, worker: Optional[str]) -> bool:
         return self.worker is None or (worker is not None and worker == self.worker)

@@ -1,0 +1,61 @@
+"""Resilient online serving plane (doc/serving.md).
+
+The ``millions of users`` half of the north star: a self-healing
+:class:`ReplicaGroup` of model workers behind a bounded request queue
+with SLO-aware continuous batching, fronted by a small HTTP server
+(``/predict``, ``/serve/stats``). Built on the robustness substrate of
+the training path — supervised respawn with jittered backoff under a
+restart budget, arbiter admission so serving and training share
+capacity, the common SIGTERM/preemption drain, and fault-plan clauses
+(``serve_kill``, ``latency``) that make failover deterministically
+testable.
+
+The invariant everything here defends: **every accepted request gets
+exactly one reply**. Replica death mid-batch requeues its un-replied
+requests onto a surviving replica (zero dropped requests); the
+replied-flag dedup keeps delivery at-most-once when a late reply races
+the retry; overload degrades to 429 + Retry-After instead of silent
+loss.
+"""
+from raydp_tpu.serve.batching import (
+    QueueFullError,
+    RequestCancelled,
+    RequestQueue,
+    SERVE_BUCKETS_ENV,
+    SERVE_MAX_BATCH_ENV,
+    SERVE_MAX_QUEUE_ENV,
+    SERVE_SLO_MS_ENV,
+    SERVE_TIMEOUT_ENV,
+    ServeRequest,
+)
+from raydp_tpu.serve.frontend import SERVE_PORT_ENV, ServeFrontend
+from raydp_tpu.serve.group import (
+    ReplicaGroup,
+    SERVE_DISPATCH_TIMEOUT_ENV,
+    SERVE_MAX_RESTARTS_ENV,
+    SERVE_REPLICAS_ENV,
+    SERVE_RESTART_BACKOFF_ENV,
+    ServeError,
+)
+from raydp_tpu.serve.replica_main import default_model
+
+__all__ = [
+    "QueueFullError",
+    "ReplicaGroup",
+    "RequestCancelled",
+    "RequestQueue",
+    "SERVE_BUCKETS_ENV",
+    "SERVE_DISPATCH_TIMEOUT_ENV",
+    "SERVE_MAX_BATCH_ENV",
+    "SERVE_MAX_QUEUE_ENV",
+    "SERVE_MAX_RESTARTS_ENV",
+    "SERVE_PORT_ENV",
+    "SERVE_REPLICAS_ENV",
+    "SERVE_RESTART_BACKOFF_ENV",
+    "SERVE_SLO_MS_ENV",
+    "SERVE_TIMEOUT_ENV",
+    "ServeError",
+    "ServeFrontend",
+    "ServeRequest",
+    "default_model",
+]

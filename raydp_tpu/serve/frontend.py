@@ -1,0 +1,204 @@
+"""HTTP frontend for the serving plane: ``/predict`` and ``/serve/stats``.
+
+Grown out of the multi-route debug server in
+:mod:`raydp_tpu.telemetry.export` (same stdlib ``ThreadingHTTPServer``
+on a daemon thread, same handle shape): one POST route that blocks the
+handler thread on the request's reply event, and one GET route
+exposing :meth:`ReplicaGroup.stats`.
+
+Graceful degradation is the contract: a full queue
+(:class:`~raydp_tpu.serve.batching.QueueFullError`) or a busy cluster
+(:class:`~raydp_tpu.control.ClusterBusyError`) becomes **429** with a
+``Retry-After`` header derived from the shed ETA; a request that
+misses its deadline becomes **504**. Anything accepted gets exactly
+one reply — the queue's id-dedup enforces at-most-once even across
+replica failover.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import math
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from raydp_tpu.serve.batching import QueueFullError, RequestCancelled
+
+logger = logging.getLogger(__name__)
+
+SERVE_PORT_ENV = "RAYDP_TPU_SERVE_PORT"
+
+
+def retry_after_s(exc: Exception) -> int:
+    """``Retry-After`` seconds from a shed error's ETA (ceil, >= 1)."""
+    eta = getattr(exc, "eta_s", None)
+    if eta is None or eta <= 0:
+        return 1
+    return max(1, int(math.ceil(eta)))
+
+
+class ServeFrontend:
+    """HTTP facade over anything with ``submit(payload, timeout_s,
+    request_id)`` and ``stats()`` — normally a
+    :class:`~raydp_tpu.serve.group.ReplicaGroup`; tests substitute
+    stubs to drive the degradation paths deterministically."""
+
+    def __init__(self, group: Any):
+        self.group = group
+        self._server = None
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        self._close_mu = threading.Lock()
+        self.port = 0
+
+    # -- request handling (transport-independent, unit-testable) --------
+
+    def handle_predict(self, body: Dict[str, Any]) -> tuple:
+        """Process one /predict body; returns ``(status, payload,
+        headers)``. Import of ClusterBusyError is local so the frontend
+        stays importable without the control plane wired."""
+        from raydp_tpu.control import ClusterBusyError
+
+        if "inputs" not in body:
+            return 400, {"error": "body must carry 'inputs'"}, {}
+        t0 = time.monotonic()
+        try:
+            req = self.group.submit(
+                body["inputs"],
+                timeout_s=body.get("timeout_s"),
+                request_id=body.get("id"),
+            )
+        except (QueueFullError, ClusterBusyError) as exc:
+            return (
+                429,
+                {
+                    "error": str(exc),
+                    "queue_depth": getattr(exc, "queue_depth", 0),
+                    "eta_s": getattr(exc, "eta_s", None),
+                },
+                {"Retry-After": str(retry_after_s(exc))},
+            )
+        try:
+            result = req.wait()
+        except RequestCancelled as exc:
+            return 504, {"error": str(exc), "id": req.request_id}, {}
+        except Exception as exc:  # replica-side model failure
+            return 500, {"error": str(exc), "id": req.request_id}, {}
+        return (
+            200,
+            {
+                "id": req.request_id,
+                "result": result,
+                "latency_s": round(time.monotonic() - t0, 6),
+                "attempts": req.attempts,
+            },
+            {},
+        )
+
+    # -- HTTP plumbing ---------------------------------------------------
+
+    def start(self, port: Optional[int] = None,
+              host: str = "127.0.0.1") -> "ServeFrontend":
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        import os
+
+        if port is None:
+            raw = os.environ.get(SERVE_PORT_ENV, "0")
+            try:
+                port = int(raw)
+            except ValueError:
+                port = 0
+        frontend = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _reply(self, code: int, body: bytes, ctype: str,
+                       headers: Optional[Dict[str, str]] = None) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _reply_json(self, code: int, payload: Dict[str, Any],
+                            headers: Optional[Dict[str, str]] = None
+                            ) -> None:
+                self._reply(
+                    code,
+                    json.dumps(payload, default=str).encode("utf-8"),
+                    "application/json",
+                    headers,
+                )
+
+            def do_POST(self):  # noqa: N802 - http.server API
+                from urllib.parse import urlsplit
+
+                if urlsplit(self.path).path != "/predict":
+                    self.send_error(404)
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    body = json.loads(
+                        self.rfile.read(length).decode("utf-8") or "{}"
+                    )
+                except (ValueError, UnicodeDecodeError):
+                    self._reply_json(400, {"error": "invalid JSON body"})
+                    return
+                try:
+                    code, payload, headers = frontend.handle_predict(body)
+                    self._reply_json(code, payload, headers)
+                except Exception as exc:
+                    try:
+                        self._reply_json(500, {"error": str(exc)})
+                    except Exception:
+                        pass
+
+            def do_GET(self):  # noqa: N802 - http.server API
+                from urllib.parse import urlsplit
+
+                path = urlsplit(self.path).path
+                try:
+                    if path == "/serve/stats":
+                        self._reply_json(200, frontend.group.stats())
+                    elif path == "/livez":
+                        self._reply_json(200, {"alive": True})
+                    else:
+                        self.send_error(404)
+                except Exception as exc:
+                    try:
+                        self.send_error(500, str(exc))
+                    except Exception:
+                        pass
+
+            def log_message(self, *args):  # silence per-request noise
+                pass
+
+        class Server(ThreadingHTTPServer):
+            # A connect burst must land in the serving queue's 429
+            # path, not die at the socket: the stdlib listen backlog
+            # of 5 resets connections the queue could have shed.
+            request_queue_size = 128
+
+        self._server = Server((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="raydp-serve-http", daemon=True,
+        )
+        self._thread.start()
+        logger.info("serving frontend on %s:%d (/predict /serve/stats)",
+                    host, self.port)
+        return self
+
+    def close(self) -> None:
+        with self._close_mu:
+            if self._closed or self._server is None:
+                return
+            self._closed = True
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)

@@ -1,0 +1,486 @@
+"""Self-healing replica group: the serving plane's supervisor.
+
+A :class:`ReplicaGroup` owns N replica *lineages*. Each lineage is a
+slot thread that spawns ``raydp_tpu.serve.replica_main`` as a child
+process, registers it (the registration reply ships the model), and
+then acts as that replica's dispatcher: pull a batch from the shared
+:class:`~raydp_tpu.serve.batching.RequestQueue`, ship it as one
+``ExecuteBatch`` envelope, deliver replies. Replica death at ANY point
+— mid-batch included — requeues the batch's un-replied requests at the
+front of the queue, where a surviving lineage's dispatcher picks them
+up: zero dropped requests, with the queue's replied-flag dedup keeping
+delivery at-most-once when a presumed-dead replica's reply races the
+retry.
+
+Supervision is the PR-10 recipe: jittered exponential backoff between
+respawns under a per-lineage restart budget
+(``RAYDP_TPU_SERVE_MAX_RESTARTS``), and group admission through the
+cluster arbiter (``slots = replicas``) so serving shares capacity with
+training — a full cluster surfaces as
+:class:`~raydp_tpu.control.ClusterBusyError` at ``start()``, which the
+HTTP frontend degrades to 429 + Retry-After.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import random
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import cloudpickle
+
+from raydp_tpu.cluster.rpc import RpcClient, RpcServer
+from raydp_tpu.serve.batching import (
+    RequestQueue,
+    ServeRequest,
+    _env_float,
+    _env_int,
+)
+from raydp_tpu.serve.replica_main import (
+    ENV_GROUP,
+    ENV_INCARNATION,
+    ENV_REPLICA,
+    ENV_SERVE_DRIVER_ADDR,
+    REPLICA_SERVICE,
+    SERVE_DRIVER_SERVICE,
+)
+from raydp_tpu.telemetry import accounting as _acct
+from raydp_tpu.telemetry import events as _events
+from raydp_tpu.utils.profiling import metrics
+
+logger = logging.getLogger(__name__)
+
+SERVE_REPLICAS_ENV = "RAYDP_TPU_SERVE_REPLICAS"
+SERVE_MAX_RESTARTS_ENV = "RAYDP_TPU_SERVE_MAX_RESTARTS"
+SERVE_RESTART_BACKOFF_ENV = "RAYDP_TPU_SERVE_RESTART_BACKOFF_S"
+SERVE_DISPATCH_TIMEOUT_ENV = "RAYDP_TPU_SERVE_DISPATCH_TIMEOUT_S"
+
+_DEFAULT_REPLICAS = 2
+_DEFAULT_MAX_RESTARTS = 3
+_DEFAULT_BACKOFF_S = 0.5
+_DEFAULT_DISPATCH_TIMEOUT_S = 30.0
+_REGISTER_TIMEOUT_S = 30.0
+
+
+class ServeError(RuntimeError):
+    """Serving control-plane failure (spawn, registration, budget)."""
+
+
+class _ReplicaSlot:
+    """One replica lineage: spawn → register → dispatch → respawn."""
+
+    def __init__(self, group: "ReplicaGroup", index: int):
+        self.group = group
+        self.index = index
+        self.restarts = 0
+        self.proc: Optional[subprocess.Popen] = None
+        self.addr: Optional[str] = None
+        self.registered = threading.Event()
+        self.alive = False
+        self.dead_lineage = False
+        self.thread = threading.Thread(
+            target=self._run, daemon=True, name=f"serve-slot-{index}"
+        )
+
+    # -- registration callback (driver RPC thread) ----------------------
+
+    def on_register(self, addr: str) -> None:
+        self.addr = addr
+        self.registered.set()
+
+    # -- lineage loop ---------------------------------------------------
+
+    def _run(self) -> None:
+        g = self.group
+        while not g._stopping.is_set():
+            if self.restarts > g.max_restarts:
+                self.dead_lineage = True
+                logger.error(
+                    "serve slot %d: restart budget exhausted "
+                    "(%d restarts); lineage abandoned",
+                    self.index, g.max_restarts,
+                )
+                _events.emit(
+                    "serve/lineage_dead", replica=self.index,
+                    restarts=self.restarts, group=g.label,
+                )
+                return
+            try:
+                self._spawn()
+            except Exception as exc:
+                logger.error(
+                    "serve slot %d: spawn failed: %s", self.index, exc
+                )
+                self._backoff()
+                continue
+            stub = RpcClient(self.addr, REPLICA_SERVICE)
+            self.alive = True
+            g._publish_alive()
+            _events.emit(
+                "serve/replica_up", replica=self.index,
+                incarnation=self.restarts, group=g.label,
+            )
+            try:
+                self._dispatch(stub)
+            finally:
+                self.alive = False
+                g._publish_alive()
+                try:
+                    stub.close()
+                except Exception:
+                    pass
+            if g._stopping.is_set():
+                return
+            metrics.counter_add("serve/restarts")
+            _events.emit(
+                "serve/replica_down", replica=self.index, group=g.label,
+                exit_code=(self.proc.poll()
+                           if self.proc is not None else None),
+            )
+            self._backoff()
+
+    def _spawn(self) -> None:
+        g = self.group
+        self.registered.clear()
+        self.addr = None
+        env = dict(os.environ)
+        env.update(
+            {
+                ENV_REPLICA: str(self.index),
+                ENV_INCARNATION: str(self.restarts),
+                ENV_GROUP: g.label,
+                ENV_SERVE_DRIVER_ADDR: g._driver_addr,
+                **_acct.env_for_child(g._job_ctx),
+            }
+        )
+        cmd = [sys.executable, "-m", "raydp_tpu.serve.replica_main"]
+        log_path = os.path.join(g._log_dir, f"replica-{self.index}.log")
+        with open(log_path, "ab") as logf:
+            self.proc = subprocess.Popen(
+                cmd, env=env, stdout=logf, stderr=subprocess.STDOUT
+            )
+        deadline = time.monotonic() + _REGISTER_TIMEOUT_S
+        while not self.registered.wait(timeout=0.1):
+            if time.monotonic() >= deadline:
+                self.proc.kill()
+                raise ServeError(
+                    f"replica {self.index} did not register within "
+                    f"{_REGISTER_TIMEOUT_S:.0f}s (log: {log_path})"
+                )
+            if self.proc.poll() is not None:
+                raise ServeError(
+                    f"replica {self.index} exited with code "
+                    f"{self.proc.returncode} before registering "
+                    f"(log: {log_path})"
+                )
+
+    def _backoff(self) -> None:
+        self.restarts += 1
+        delay = self.group.restart_backoff_s * (2 ** (self.restarts - 1))
+        delay *= 1.0 + random.uniform(0.0, 0.25)
+        self.group._stopping.wait(timeout=delay)
+
+    # -- dispatch -------------------------------------------------------
+
+    def _dispatch(self, stub: RpcClient) -> None:
+        """Pull batches and ship them until the replica dies or the
+        group stops. Every failure path requeues the batch."""
+        g = self.group
+        while not g._stopping.is_set():
+            if self.proc is not None and self.proc.poll() is not None:
+                return
+            batch = g.queue.next_batch(wait_timeout=0.25)
+            if not batch:
+                continue
+            payload = {
+                "requests": [
+                    {"id": r.request_id, "payload": r.payload}
+                    for r in batch
+                ],
+                "bucket": g.queue.bucket_for(
+                    max(r.length for r in batch)
+                ),
+            }
+            t0 = time.monotonic()
+            try:
+                reply = stub.call(
+                    "ExecuteBatch", payload, timeout=g.dispatch_timeout_s
+                )
+            except Exception:
+                # Dead or unreachable replica mid-batch: the requests
+                # go BACK to the queue head and retry on a surviving
+                # replica — the zero-dropped-request guarantee.
+                g.queue.requeue(batch)
+                return
+            if reply.get("draining"):
+                # Drain refusal: replica got SIGTERM/preemption after
+                # assembly; hand the batch to a healthy lineage and
+                # wait out this incarnation.
+                g.queue.requeue(batch)
+                self._await_exit()
+                return
+            wall = time.monotonic() - t0
+            g.queue.observe_service_time(wall / max(1, len(batch)))
+            metrics.timer(f"serve/replica/{self.index}/latency").observe(
+                wall
+            )
+            results = reply.get("results") or []
+            for req, result in zip(batch, results):
+                g.queue.complete(req, result=result)
+            for req in batch[len(results):]:
+                g.queue.complete(
+                    req, error="replica returned short batch"
+                )
+
+    def _await_exit(self) -> None:
+        if self.proc is None:
+            return
+        deadline = time.monotonic() + self.group.dispatch_timeout_s
+        while time.monotonic() < deadline:
+            if self.proc.poll() is not None:
+                return
+            time.sleep(0.05)
+
+
+class ReplicaGroup:
+    """N supervised serving replicas behind one bounded request queue."""
+
+    def __init__(
+        self,
+        replicas: Optional[int] = None,
+        model_fn: Optional[Callable[[List[Any], int], List[Any]]] = None,
+        label: str = "serve",
+        max_queue: Optional[int] = None,
+        slo_ms: Optional[float] = None,
+        max_batch: Optional[int] = None,
+        buckets: Optional[List[int]] = None,
+        max_restarts: Optional[int] = None,
+        restart_backoff_s: Optional[float] = None,
+        dispatch_timeout_s: Optional[float] = None,
+    ):
+        self.replicas = (
+            _env_int(SERVE_REPLICAS_ENV, _DEFAULT_REPLICAS)
+            if replicas is None else int(replicas)
+        )
+        self.model_fn = model_fn
+        self.label = label
+        self.max_restarts = (
+            _env_int(SERVE_MAX_RESTARTS_ENV, _DEFAULT_MAX_RESTARTS)
+            if max_restarts is None else int(max_restarts)
+        )
+        self.restart_backoff_s = (
+            _env_float(SERVE_RESTART_BACKOFF_ENV, _DEFAULT_BACKOFF_S)
+            if restart_backoff_s is None else float(restart_backoff_s)
+        )
+        self.dispatch_timeout_s = (
+            _env_float(SERVE_DISPATCH_TIMEOUT_ENV,
+                       _DEFAULT_DISPATCH_TIMEOUT_S)
+            if dispatch_timeout_s is None else float(dispatch_timeout_s)
+        )
+        self.queue = RequestQueue(
+            max_depth=max_queue, slo_ms=slo_ms,
+            max_batch=max_batch, buckets=buckets,
+        )
+        self._slots: List[_ReplicaSlot] = []
+        self._stopping = threading.Event()
+        self._started = False
+        self._server: Optional[RpcServer] = None
+        self._driver_addr = ""
+        self._log_dir = ""
+        self._job_ctx = None
+        self._owns_job_ctx = False
+        self._sched_lease = None
+        self._model_blob: Optional[bytes] = None
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> "ReplicaGroup":
+        """Admit through the arbiter, bring up the driver RPC surface,
+        and launch every lineage. Raises
+        :class:`~raydp_tpu.control.ClusterBusyError` when the cluster
+        has no capacity for the group."""
+        if self._started:
+            raise ServeError(f"replica group {self.label} already started")
+        self._stopping.clear()
+        self._job_ctx = _acct.current_job()
+        self._owns_job_ctx = self._job_ctx is None
+        if self._job_ctx is None:
+            self._job_ctx = _acct.mint_job(
+                self.label, world_size=self.replicas
+            )
+            _acct.set_process_job(self._job_ctx)
+        from raydp_tpu.control import get_arbiter
+
+        self._sched_lease = get_arbiter().ensure_admitted(
+            self._job_ctx, slots=self.replicas, label=self.label,
+            on_preempt=self._on_preempt,
+        )
+        if self.model_fn is not None:
+            self._model_blob = cloudpickle.dumps(self.model_fn)
+        self._server = RpcServer(
+            SERVE_DRIVER_SERVICE,
+            {
+                "RegisterReplica": self._on_register_replica,
+                "Ping": lambda req: {"pong": True},
+            },
+        )
+        self._driver_addr = f"127.0.0.1:{self._server.port}"
+        self._log_dir = os.path.join(
+            "/tmp/raydp_tpu", "serve", f"{self.label}-{os.getpid()}"
+        )
+        os.makedirs(self._log_dir, exist_ok=True)
+        _events.emit(
+            "serve/start", group=self.label, replicas=self.replicas,
+            max_batch=self.queue.max_batch,
+            slo_ms=self.queue.slo_s * 1000.0,
+        )
+        self._slots = [
+            _ReplicaSlot(self, i) for i in range(self.replicas)
+        ]
+        self._started = True
+        for slot in self._slots:
+            slot.thread.start()
+        return self
+
+    def _on_register_replica(self, req: dict) -> dict:
+        idx = int(req["replica"])
+        if not 0 <= idx < len(self._slots):
+            raise ServeError(f"unknown replica index {idx}")
+        self._slots[idx].on_register(req["addr"])
+        return {
+            "ok": True,
+            "model": self._model_blob,
+            "buckets": list(self.queue.buckets),
+        }
+
+    def _on_preempt(self) -> None:
+        """Arbiter victim teardown: the whole group drains — replicas
+        finish their in-flight batches and the queue stops admitting."""
+        _events.emit("serve/preempt", group=self.label)
+        threading.Thread(target=self.stop, daemon=True).start()
+
+    def _publish_alive(self) -> None:
+        metrics.gauge_set(
+            "serve/replicas_alive",
+            sum(1 for s in self._slots if s.alive),
+        )
+
+    # -- request path ---------------------------------------------------
+
+    def submit(self, payload: Any, timeout_s: Optional[float] = None,
+               request_id: Optional[str] = None) -> ServeRequest:
+        """Admit one request (non-blocking). Raises
+        :class:`~raydp_tpu.serve.batching.QueueFullError` on overflow;
+        the returned request's ``wait()`` blocks for the reply."""
+        if not self._started:
+            raise ServeError(f"replica group {self.label} not started")
+        req = ServeRequest(payload, timeout_s=timeout_s,
+                           request_id=request_id)
+        self.queue.submit(req)
+        return req
+
+    def predict(self, payload: Any,
+                timeout_s: Optional[float] = None) -> Any:
+        return self.submit(payload, timeout_s=timeout_s).wait()
+
+    # -- introspection --------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        lat = metrics.timer("serve/latency").summary()
+        thr = metrics.meter("serve/throughput").summary()
+        snap = metrics.snapshot().get("counters", {})
+        batches = snap.get("serve/batches", 0.0)
+        batch_requests = snap.get("serve/batch_requests", 0.0)
+        fill = (
+            batch_requests / (batches * self.queue.max_batch)
+            if batches else 0.0
+        )
+        per_replica = {}
+        for slot in self._slots:
+            s = metrics.timer(
+                f"serve/replica/{slot.index}/latency"
+            ).summary()
+            per_replica[str(slot.index)] = {
+                "alive": slot.alive,
+                "restarts": slot.restarts,
+                "p50_s": s["p50_s"],
+                "p99_s": s["p99_s"],
+                "batches": s["count"],
+            }
+        return {
+            "group": self.label,
+            "replicas": self.replicas,
+            "replicas_alive": sum(1 for s in self._slots if s.alive),
+            "dead_lineages": sum(
+                1 for s in self._slots if s.dead_lineage
+            ),
+            "queue_depth": self.queue.depth(),
+            "max_batch": self.queue.max_batch,
+            "slo_ms": self.queue.slo_s * 1000.0,
+            "accepted": snap.get("serve/requests", 0.0),
+            "replies": snap.get("serve/replies", 0.0),
+            "errors": snap.get("serve/errors", 0.0),
+            "rejected": snap.get("serve/rejected", 0.0),
+            "requeued": snap.get("serve/requeued", 0.0),
+            "dup_replies": snap.get("serve/dup_replies", 0.0),
+            "restarts": snap.get("serve/restarts", 0.0),
+            "batch_fill": round(fill, 4),
+            "requests_per_sec": round(thr["per_sec"], 3),
+            "latency_p50_s": lat["p50_s"],
+            "latency_p99_s": lat["p99_s"],
+            "per_replica": per_replica,
+        }
+
+    # -- shutdown -------------------------------------------------------
+
+    def stop(self) -> None:
+        """Graceful teardown: stop admitting, stop replicas, release
+        the arbiter lease. Idempotent."""
+        if not self._started:
+            return
+        self._started = False
+        self._stopping.set()
+        self.queue.close()
+        for slot in self._slots:
+            if slot.addr and slot.proc is not None \
+                    and slot.proc.poll() is None:
+                try:
+                    RpcClient(slot.addr, REPLICA_SERVICE).try_call(
+                        "Stop", {}, timeout=2.0
+                    )
+                except Exception:
+                    pass
+        for slot in self._slots:
+            slot.thread.join(timeout=5.0)
+            if slot.proc is not None and slot.proc.poll() is None:
+                slot.proc.terminate()
+                try:
+                    slot.proc.wait(timeout=5.0)
+                except subprocess.TimeoutExpired:
+                    slot.proc.kill()
+        if self._server is not None:
+            try:
+                self._server.stop(grace=0.5)
+            except Exception:
+                pass
+            self._server = None
+        if self._sched_lease is not None:
+            try:
+                self._sched_lease.release()
+            except Exception:
+                pass
+            self._sched_lease = None
+        if self._owns_job_ctx:
+            _acct.set_process_job(None)
+            self._owns_job_ctx = False
+        _events.emit("serve/stop", group=self.label)
+
+    def __enter__(self) -> "ReplicaGroup":
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb) -> None:
+        self.stop()

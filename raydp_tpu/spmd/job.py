@@ -40,6 +40,8 @@ from raydp_tpu.telemetry import events as _events
 from raydp_tpu.telemetry import flight_recorder as _flight
 from raydp_tpu.telemetry import watchdog as _watchdog
 from raydp_tpu.utils.net import find_free_port
+from raydp_tpu.utils.profiling import CompileError
+from raydp_tpu.utils.profiling import metrics as _metrics
 
 logger = logging.getLogger(__name__)
 
@@ -60,6 +62,26 @@ ENV_PROCS_PER_NODE = "RAYDP_SPMD_PROCS_PER_NODE"
 # busy host) are waited on up to the hard cap.
 ENV_REGISTER_TIMEOUT = "RAYDP_SPMD_REGISTER_TIMEOUT"
 ENV_REGISTER_HARD_TIMEOUT = "RAYDP_SPMD_REGISTER_HARD_TIMEOUT"
+# Dispatch-payload shipping policy. Payloads (fn closure + scatter blob)
+# above the inline cap leave the RPC envelope and travel the chunked
+# shm-store fetch path instead — the fix for the seq-16384
+# dense-attention dispatch 500s, where a jaxpr-laden closure blew the
+# one-envelope ceiling. The hard cap is the fail-fast guard: anything
+# bigger than the transport can ever carry raises a structured
+# CompileError instead of timing out against a wedged channel.
+ENV_INLINE_CAP = "RAYDP_TPU_RPC_INLINE_CAP_MB"
+ENV_PAYLOAD_HARD_CAP = "RAYDP_TPU_RPC_PAYLOAD_HARD_CAP_MB"
+_DEFAULT_INLINE_CAP_MB = 64.0
+_DEFAULT_HARD_CAP_MB = 448.0  # headroom under the 512 MB gRPC ceiling
+
+
+def _env_mb(name: str, default_mb: float) -> int:
+    raw = os.environ.get(name)
+    try:
+        mb = float(raw) if raw else default_mb
+    except ValueError:
+        mb = default_mb
+    return int(mb * 1024 * 1024)
 
 
 class SPMDJobError(RuntimeError):
@@ -189,6 +211,10 @@ class SPMDJob:
         # (None when a supervisor such as fit_spmd already admitted
         # this job, or when the arbiter is disabled).
         self._sched_lease = None
+        # Driver-local staging store for oversize dispatch payloads:
+        # blobs above the inline cap are parked here and ranks pull
+        # them through the chunked FetchObjectChunk path.
+        self._blob_store = None
         # Per-rank metrics merged from heartbeat-shipped deltas; survives
         # gang restarts (ranks keep their keys across incarnations).
         self.telemetry = ClusterTelemetry()
@@ -229,6 +255,14 @@ class SPMDJob:
         from raydp_tpu.utils.net import local_ip
 
         bind_host = "0.0.0.0" if self._multihost else "127.0.0.1"
+        # The driver doubles as a store agent for dispatch blobs: ranks
+        # pull oversize fn/args payloads via the same chunked
+        # FetchObjectChunk protocol the data plane uses cross-host.
+        from raydp_tpu.store.agent import agent_handlers
+        from raydp_tpu.store.object_store import ObjectStore
+
+        if self._blob_store is None:
+            self._blob_store = ObjectStore()
         self._server = RpcServer(
             DRIVER_SERVICE,
             {
@@ -236,6 +270,9 @@ class SPMDJob:
                 "FuncResult": self._on_func_result,
                 "JobFailed": self._on_job_failed,
                 "Ping": self._on_ping,
+                "FetchObjectChunk": agent_handlers(self._blob_store)[
+                    "FetchObjectChunk"
+                ],
             },
             host=bind_host,
         )
@@ -687,6 +724,7 @@ class SPMDJob:
             self._inflight = results
         _flight.record("dispatch", "start", job=self.job_name,
                        func_id=func_id)
+        staged_ids: List[str] = []
         try:
             # A gang that never reports back (rank wedged in a
             # collective) is attributed as "spmd/dispatch" on the driver
@@ -699,21 +737,81 @@ class SPMDJob:
             ), span("spmd/dispatch", job=self.job_name,
                     func_id=func_id, world_size=self.world_size):
                 fn_blob = cloudpickle.dumps(fn)
+                inline_cap = _env_mb(
+                    ENV_INLINE_CAP, _DEFAULT_INLINE_CAP_MB
+                )
+                hard_cap = _env_mb(
+                    ENV_PAYLOAD_HARD_CAP, _DEFAULT_HARD_CAP_MB
+                )
                 for rank, stub in self._stubs.items():
-                    payload = {"func_id": func_id, "fn": fn_blob}
-                    # Deadline sized to the payload (fn closure + scatter
-                    # blob) at a worst-case ~10 MB/s over DCN, on top of
-                    # the control default — NOT the whole-job timeout,
-                    # which would let the serial send loop hide failures
-                    # for world×timeout.
+                    payload: Dict[str, Any] = {"func_id": func_id}
+                    blobs = {"fn": fn_blob}
                     nbytes = len(fn_blob)
                     if per_rank_args is not None:
                         blob = cloudpickle.dumps(tuple(per_rank_args[rank]))
-                        payload["args"] = blob
+                        blobs["args"] = blob
                         nbytes += len(blob)
-                    stub.call(
-                        "RunFunction", payload, timeout=10.0 + nbytes / 10e6
-                    )
+                    if nbytes > hard_cap:
+                        # Fail fast with the structured error a
+                        # supervisor can act on — not a wedged channel
+                        # followed by a timeout (retrying an
+                        # over-the-ceiling payload is deterministic
+                        # waste, so retryable=False).
+                        raise CompileError(
+                            f"dispatch payload for rank {rank} is "
+                            f"{nbytes} bytes, over the "
+                            f"{ENV_PAYLOAD_HARD_CAP} hard cap of "
+                            f"{hard_cap} bytes",
+                            label=f"{self.job_name}/func{func_id}",
+                            duration_s=0.0,
+                            payload_bytes=nbytes,
+                            retryable=False,
+                        )
+                    if nbytes > inline_cap:
+                        # Oversize payload: stage in the driver-local
+                        # store; the envelope carries only refs and the
+                        # rank pulls the bytes back in bounded chunks.
+                        for key, blob in blobs.items():
+                            ref = self._blob_store.put(blob)
+                            staged_ids.append(ref.object_id)
+                            payload[f"{key}_ref"] = ref.object_id
+                            payload[f"{key}_size"] = len(blob)
+                        _metrics.counter_add("spmd/oversize_dispatches")
+                        _metrics.counter_add("spmd/staged_bytes", nbytes)
+                        send_bytes = 4096
+                    else:
+                        payload.update(blobs)
+                        send_bytes = nbytes
+                    # Deadline sized to the bytes actually riding THIS
+                    # envelope (refs make it constant) at a worst-case
+                    # ~10 MB/s over DCN, on top of the control default —
+                    # NOT the whole-job timeout, which would let the
+                    # serial send loop hide failures for world×timeout.
+                    try:
+                        stub.call(
+                            "RunFunction", payload,
+                            timeout=10.0 + send_bytes / 10e6,
+                        )
+                    except Exception as exc:
+                        if nbytes <= inline_cap:
+                            raise
+                        # The guard still tripped on an oversize
+                        # dispatch: surface it as the structured
+                        # compile failure (payload size + server-side
+                        # failure class) instead of a generic RPC error.
+                        code = getattr(exc, "code", None)
+                        raise CompileError(
+                            f"oversize dispatch to rank {rank} failed "
+                            f"after staging ({nbytes} bytes): {exc}",
+                            label=f"{self.job_name}/func{func_id}",
+                            duration_s=0.0,
+                            payload_bytes=nbytes,
+                            server_exception=(
+                                str(code()) if callable(code)
+                                else type(exc).__name__
+                            ),
+                            retryable=True,
+                        ) from exc
                 if not results.done.wait(timeout or max(self.timeout, 60.0)):
                     raise SPMDJobError(
                         f"function {func_id} timed out on job "
@@ -735,6 +833,13 @@ class SPMDJob:
                 return results.results
         finally:
             self._inflight = None
+            # Staged blobs are per-dispatch; every rank has either
+            # fetched them or failed by now.
+            for object_id in staged_ids:
+                try:
+                    self._blob_store.delete(object_id)
+                except Exception:
+                    pass
 
     def request_preemption(self) -> None:
         """Deliver a preemption notice to every live rank (driver side)
@@ -805,6 +910,12 @@ class SPMDJob:
         if self._server is not None:
             self._server.stop()
         self._server = None
+        if self._blob_store is not None:
+            try:
+                self._blob_store.destroy()
+            except Exception:
+                pass
+            self._blob_store = None
         self._procs = []
         self._stubs = {}
         self._worker_addrs = {}

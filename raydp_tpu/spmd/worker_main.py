@@ -155,6 +155,58 @@ class SPMDWorker:
                        nbytes=len(payload.get("zip") or b""))
         return payload
 
+    def _payload_blob(self, item: dict, key: str) -> Optional[bytes]:
+        """Bytes for ``key`` (``fn`` / ``args``) of a queued dispatch.
+
+        Inline payloads ride the envelope as before; oversize payloads
+        arrive as ``<key>_ref`` and are pulled back from the driver's
+        staging store in bounded chunks — the same FetchObjectChunk
+        protocol (and chunk-size env) the cross-host data plane uses,
+        so a seq-16384 closure never has to fit one RPC message.
+        """
+        blob = item.get(key)
+        if blob is not None:
+            return blob
+        object_id = item.get(f"{key}_ref")
+        if object_id is None:
+            return None
+        from raydp_tpu.store.resolver import _fetch_chunk_bytes
+        from raydp_tpu.utils.profiling import metrics as _metrics
+
+        chunk = max(1024 * 1024, _fetch_chunk_bytes())
+        reply = self.driver.call(
+            "FetchObjectChunk",
+            {"object_id": object_id, "offset": 0, "length": chunk},
+            timeout=120.0,
+        )
+        total = int(reply["size"])
+        first = reply["data"]
+        buf = bytearray(total)
+        buf[: len(first)] = first
+        offset = len(first)
+        while offset < total:
+            part = self.driver.call(
+                "FetchObjectChunk",
+                {"object_id": object_id, "offset": offset, "length": chunk},
+                timeout=120.0,
+            )["data"]
+            if not part:
+                raise RuntimeError(
+                    f"short read fetching dispatch blob {object_id}: "
+                    f"{offset}/{total} bytes"
+                )
+            buf[offset: offset + len(part)] = part
+            offset += len(part)
+        expect = int(item.get(f"{key}_size") or total)
+        if offset != expect:
+            raise RuntimeError(
+                f"dispatch blob {object_id} size mismatch: fetched "
+                f"{offset}, expected {expect}"
+            )
+        _metrics.counter_add("spmd/blob_fetches")
+        _metrics.counter_add("spmd/blob_fetch_bytes", total)
+        return bytes(buf)
+
     def _runner(self) -> None:
         while not self._stop_event.is_set():
             item = self._queue.get()
@@ -200,10 +252,11 @@ class SPMDWorker:
                 "spmd/func", rank=self.rank, func_id=func_id
             ) as sp:
                 try:
-                    fn = cloudpickle.loads(item["fn"])
+                    fn = cloudpickle.loads(self._payload_blob(item, "fn"))
+                    args_blob = self._payload_blob(item, "args")
                     args = (
-                        cloudpickle.loads(item["args"])
-                        if item.get("args") is not None
+                        cloudpickle.loads(args_blob)
+                        if args_blob is not None
                         else ()
                     )
                     value = fn(self.ctx, *args)

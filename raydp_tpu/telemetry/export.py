@@ -459,6 +459,84 @@ def render_prometheus(view: Dict[str, Any]) -> str:
         "Admissions rejected with ClusterBusyError by the load-shedding "
         "cap (queue at RAYDP_TPU_SCHED_MAX_QUEUE or explicit shed mode).",
     )
+    serve_requests = _Family(
+        "raydp_serve_requests_total", "counter",
+        "Requests accepted into the serving queue (doc/serving.md).",
+    )
+    serve_replies = _Family(
+        "raydp_serve_replies_total", "counter",
+        "Requests answered successfully (the exactly-one-reply "
+        "invariant: replies + errors + cancellations == accepted).",
+    )
+    serve_errors = _Family(
+        "raydp_serve_errors_total", "counter",
+        "Requests completed with an error reply (model failure or "
+        "deadline expiry while queued).",
+    )
+    serve_rejected = _Family(
+        "raydp_serve_rejected_total", "counter",
+        "Requests shed at admission — queue at RAYDP_TPU_SERVE_MAX_QUEUE "
+        "turns into HTTP 429 with a Retry-After derived from shed ETA.",
+    )
+    serve_requeued = _Family(
+        "raydp_serve_requeued_total", "counter",
+        "In-flight requests returned to the front of the queue after a "
+        "replica died mid-batch (the zero-drop failover path).",
+    )
+    serve_dup_replies = _Family(
+        "raydp_serve_duplicate_replies_total", "counter",
+        "Replica replies discarded because the request had already been "
+        "answered (at-most-once delivery under failover).",
+    )
+    serve_restarts = _Family(
+        "raydp_serve_restarts_total", "counter",
+        "Replica respawns by the group's supervision loop (bounded by "
+        "RAYDP_TPU_SERVE_MAX_RESTARTS per lineage).",
+    )
+    serve_batches = _Family(
+        "raydp_serve_batches_total", "counter",
+        "Batches dispatched by the continuous batcher.",
+    )
+    serve_batch_requests = _Family(
+        "raydp_serve_batch_requests_total", "counter",
+        "Requests carried inside dispatched batches (ratio against "
+        "batches x max_batch is the aggregate fill fraction).",
+    )
+    serve_queue_depth = _Family(
+        "raydp_serve_queue_depth", "gauge",
+        "Requests waiting in the serving queue right now.",
+    )
+    serve_batch_fill = _Family(
+        "raydp_serve_batch_fill", "gauge",
+        "Fill fraction (size / max_batch) of the most recent batch.",
+    )
+    serve_replicas_alive = _Family(
+        "raydp_serve_replicas_alive", "gauge",
+        "Replicas currently registered and serving in the group.",
+    )
+    serve_rps = _Family(
+        "raydp_serve_requests_per_second", "gauge",
+        "Reply throughput of the serving plane since start.",
+    )
+    serve_latency = _Family(
+        "raydp_serve_latency_seconds", "summary",
+        "End-to-end request latency (accept to reply) on the driver.",
+    )
+    serve_replica_latency = _Family(
+        "raydp_serve_replica_latency_seconds", "summary",
+        "Per-replica ExecuteBatch wall time, labelled by replica index.",
+    )
+    serve_counter_routes = {
+        "serve/requests": serve_requests,
+        "serve/replies": serve_replies,
+        "serve/errors": serve_errors,
+        "serve/rejected": serve_rejected,
+        "serve/requeued": serve_requeued,
+        "serve/dup_replies": serve_dup_replies,
+        "serve/restarts": serve_restarts,
+        "serve/batches": serve_batches,
+        "serve/batch_requests": serve_batch_requests,
+    }
 
     sources: Dict[str, Dict[str, Any]] = dict(view.get("workers") or {})
     driver = view.get("driver")
@@ -632,6 +710,15 @@ def render_prometheus(view: Dict[str, Any]) -> str:
                     if name == "sched/sheds":
                         sched_sheds.add({"worker": worker_id}, section[name])
                         continue
+                    if name in ("serve/requests", "serve/replies",
+                                "serve/errors", "serve/rejected",
+                                "serve/requeued", "serve/dup_replies",
+                                "serve/restarts", "serve/batches",
+                                "serve/batch_requests"):
+                        serve_counter_routes[name].add(
+                            {"worker": worker_id}, section[name]
+                        )
+                        continue
                     counters.add(
                         {"worker": worker_id, "name": name}, section[name]
                     )
@@ -661,6 +748,12 @@ def render_prometheus(view: Dict[str, Any]) -> str:
                         )
                     elif name == "sched/queue_depth":
                         sched_queue_depth.add({"worker": worker_id}, value)
+                    elif name == "serve/queue_depth":
+                        serve_queue_depth.add({"worker": worker_id}, value)
+                    elif name == "serve/batch_fill":
+                        serve_batch_fill.add({"worker": worker_id}, value)
+                    elif name == "serve/replicas_alive":
+                        serve_replicas_alive.add({"worker": worker_id}, value)
                     elif name == "mfu":
                         mfu.add({"worker": worker_id}, value)
                     else:
@@ -668,18 +761,38 @@ def render_prometheus(view: Dict[str, Any]) -> str:
                             {"worker": worker_id, "name": name}, value
                         )
             elif key.startswith("meter/"):
-                labels = {"worker": worker_id, "name": key[len("meter/"):]}
+                mname = key[len("meter/"):]
+                labels = {"worker": worker_id, "name": mname}
                 meter_total.add(labels, section.get("total", 0.0))
                 meter_rate.add(labels, section.get("per_sec", 0.0))
+                if mname == "serve/throughput":
+                    # The serving plane's headline rate also gets its own
+                    # family so dashboards don't need label matching.
+                    serve_rps.add(
+                        {"worker": worker_id}, section.get("per_sec", 0.0)
+                    )
             elif key.startswith("timer/"):
-                labels = {"worker": worker_id, "name": key[len("timer/"):]}
+                tname = key[len("timer/"):]
+                if tname == "serve/latency":
+                    family = serve_latency
+                    labels = {"worker": worker_id}
+                elif tname.startswith("serve/replica/"):
+                    family = serve_replica_latency
+                    labels = {
+                        "worker": worker_id,
+                        "replica":
+                            tname[len("serve/replica/"):].split("/", 1)[0],
+                    }
+                else:
+                    family = timers
+                    labels = {"worker": worker_id, "name": tname}
                 for q, stat in (("0.5", "p50_s"), ("0.9", "p90_s"),
                                 ("0.99", "p99_s")):
-                    timers.add(
+                    family.add(
                         {**labels, "quantile": q}, section.get(stat, 0.0)
                     )
-                timers.add(labels, section.get("total_s", 0.0), suffix="_sum")
-                timers.add(labels, section.get("count", 0.0), suffix="_count")
+                family.add(labels, section.get("total_s", 0.0), suffix="_sum")
+                family.add(labels, section.get("count", 0.0), suffix="_count")
             elif key.startswith("hist/"):
                 name = key[len("hist/"):]
                 if name == "train/step_seconds":
@@ -720,6 +833,12 @@ def render_prometheus(view: Dict[str, Any]) -> str:
                    job_counter,
                    sched_queue_depth, sched_preemptions, sched_wait,
                    sched_sheds,
+                   serve_requests, serve_replies, serve_errors,
+                   serve_rejected, serve_requeued, serve_dup_replies,
+                   serve_restarts, serve_batches, serve_batch_requests,
+                   serve_queue_depth, serve_batch_fill,
+                   serve_replicas_alive, serve_rps, serve_latency,
+                   serve_replica_latency,
                    host_rss,
                    hbm_bytes, store_occupancy, mfu, anomalies, step_hist,
                    generic_hist, gauges):

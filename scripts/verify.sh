@@ -338,6 +338,79 @@ np.testing.assert_allclose(
 PYEOF
   rm -rf "$sched_dir"
 fi
+# Serving smoke (HARD): a replica group under concurrent traffic with
+# an injected replica kill must reply to every accepted request
+# exactly once (zero drops), keep batches usefully full, and self-heal
+# back to full strength — the end-to-end proof of doc/serving.md's
+# zero-dropped-request failover story, not just its unit tests.
+if [ "$rc" -eq 0 ]; then
+  echo "--- serving smoke (replica kill under traffic) ---"
+  JAX_PLATFORMS=cpu RAYDP_TPU_FAULT_PLAN="serve_kill:replica=0,request=5" \
+    python - <<'PYEOF' \
+    && echo "SERVE_SMOKE=ok" || { echo "SERVE_SMOKE=failed"; rc=1; }
+import threading
+import time
+
+from raydp_tpu.serve import ReplicaGroup
+from raydp_tpu.utils.profiling import metrics
+
+
+def make_model():
+    # Nested so cloudpickle ships it by value to the replica procs.
+    def model(payloads, bucket):
+        time.sleep(0.002)
+        return [float(sum(p)) for p in payloads]
+
+    return model
+
+
+N, PER = 240, 30
+results = [None] * N
+errors = []
+
+with ReplicaGroup(
+    replicas=2, model_fn=make_model(), label="smoke-serve",
+    max_batch=4, slo_ms=25, max_queue=N + 16, restart_backoff_s=0.2,
+).start() as group:
+
+    def client(base):
+        reqs = [
+            (i, group.submit([i % 5] * 8, timeout_s=120.0))
+            for i in range(base, base + PER)
+        ]
+        for i, req in reqs:
+            try:
+                results[i] = req.wait(timeout=120.0)
+            except Exception as exc:  # any drop/cancel fails the gate
+                errors.append((i, repr(exc)))
+
+    threads = [
+        threading.Thread(target=client, args=(b,))
+        for b in range(0, N, PER)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # Self-heal: the killed lineage must respawn back to full strength.
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        stats = group.stats()
+        if stats["restarts"] >= 1 and stats["replicas_alive"] == 2:
+            break
+        time.sleep(0.1)
+
+assert not errors, errors[:3]
+assert results == [float((i % 5) * 8) for i in range(N)], \
+    "replies diverged"
+assert stats["restarts"] >= 1, stats
+assert stats["replicas_alive"] == 2, stats
+assert stats["replies"] == N and stats["errors"] == 0, stats
+snap = metrics.snapshot()["counters"]
+fill = snap["serve/batch_requests"] / (snap["serve/batches"] * 4)
+assert fill > 0.5, (fill, snap)
+PYEOF
+fi
 # Bench regression gate (ADVISORY): when two result files exist, diff
 # the newest pair; a >10% throughput/MFU regression prints loudly but
 # never fails the tier-1 gate (bench noise on shared CI boxes is real

@@ -1,0 +1,54 @@
+"""Oversize SPMD dispatch payloads: chunked staging and the hard cap.
+
+Regression suite for the seq-16384 failure mode: a dispatch whose
+cloudpickled fn/args outgrow the RPC envelope used to wedge the gRPC
+channel and surface as an opaque timeout. Now payloads above
+``RAYDP_TPU_RPC_INLINE_CAP_MB`` ride the driver's shm store (the
+envelope carries refs; ranks pull the bytes back in bounded chunks),
+and payloads above ``RAYDP_TPU_RPC_PAYLOAD_HARD_CAP_MB`` fail fast
+with a structured :class:`CompileError` carrying ``payload_bytes``.
+"""
+import pytest
+
+from raydp_tpu.spmd import create_spmd_job
+from raydp_tpu.utils.profiling import CompileError, metrics
+
+WORLD = 2
+
+
+def test_oversize_args_are_staged_not_inlined(monkeypatch):
+    monkeypatch.setenv("RAYDP_TPU_RPC_INLINE_CAP_MB", "1")
+    metrics.reset()
+    shard = bytes(2 * 1024 * 1024)  # 2 MB per rank, over the 1 MB cap
+    with create_spmd_job("t-staged", world_size=WORLD, timeout=60) as job:
+        sizes = job.run(
+            lambda ctx, data: (ctx.rank, len(data)),
+            per_rank_args=[(shard,) for _ in range(WORLD)],
+        )
+        assert sizes == [(r, len(shard)) for r in range(WORLD)]
+        snap = metrics.snapshot()["counters"]
+        assert snap["spmd/oversize_dispatches"] == WORLD
+        assert snap["spmd/staged_bytes"] > WORLD * len(shard)
+        # a small follow-up dispatch goes back to the inline path
+        assert job.run(lambda ctx: ctx.rank) == list(range(WORLD))
+        snap = metrics.snapshot()["counters"]
+        assert snap["spmd/oversize_dispatches"] == WORLD
+
+
+def test_hard_cap_fails_fast_with_structured_error(monkeypatch):
+    monkeypatch.setenv("RAYDP_TPU_RPC_INLINE_CAP_MB", "1")
+    monkeypatch.setenv("RAYDP_TPU_RPC_PAYLOAD_HARD_CAP_MB", "4")
+    big = bytes(6 * 1024 * 1024)  # over the 4 MB hard cap
+    with create_spmd_job("t-capped", world_size=WORLD, timeout=60) as job:
+        with pytest.raises(CompileError) as ei:
+            job.run(
+                lambda ctx, data: len(data),
+                per_rank_args=[(big,) for _ in range(WORLD)],
+            )
+        err = ei.value
+        assert err.payload_bytes is not None
+        assert err.payload_bytes > 4 * 1024 * 1024
+        assert err.retryable is False
+        assert "hard cap" in str(err)
+        # the gang survives the refused dispatch; the job stays usable
+        assert job.run(lambda ctx: "alive") == ["alive"] * WORLD

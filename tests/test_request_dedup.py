@@ -1,0 +1,108 @@
+"""Worker-side request-id dedup: at-most-once task execution.
+
+A client reconnect retry can re-deliver a RunTask envelope the worker
+already executed (or is still executing). The dedup cache keyed on the
+client-minted ``request_id`` turns re-delivery into wait-for-the-first
+instead of a second execution — the property serving dispatches (not
+idempotent) lean on.
+"""
+import threading
+import time
+from collections import OrderedDict
+
+import pytest
+
+from raydp_tpu.cluster.worker_main import _DEDUP_CAPACITY, Worker
+from raydp_tpu.utils.profiling import metrics
+
+
+def _bare_worker(execute):
+    """A Worker with only the dedup-wrapper state wired, its task body
+    replaced — no RPC server, no registration, no cluster."""
+    w = Worker.__new__(Worker)
+    w._dedup = OrderedDict()
+    w._dedup_lock = threading.Lock()
+    w._execute_task = execute
+    return w
+
+
+def test_duplicate_envelope_executes_once():
+    metrics.reset()
+    calls = []
+
+    def execute(req):
+        calls.append(req["request_id"])
+        return {"result": f"ran-{len(calls)}"}
+
+    w = _bare_worker(execute)
+    req = {"request_id": "rid-1", "fn": b""}
+    first = w._on_run_task(req)
+    second = w._on_run_task(req)
+    assert first == {"result": "ran-1"}
+    assert second is first  # cached reply, not a re-execution
+    assert calls == ["rid-1"]
+    assert metrics.snapshot()["counters"]["worker/dup_tasks"] == 1
+
+
+def test_concurrent_duplicate_waits_for_original():
+    started = threading.Event()
+    release = threading.Event()
+
+    def execute(req):
+        started.set()
+        assert release.wait(timeout=10.0)
+        return {"result": "slow"}
+
+    w = _bare_worker(execute)
+    req = {"request_id": "rid-slow"}
+    replies = []
+    t1 = threading.Thread(target=lambda: replies.append(w._on_run_task(req)))
+    t1.start()
+    assert started.wait(timeout=5.0)
+    # duplicate lands while the original is still executing
+    t2 = threading.Thread(target=lambda: replies.append(w._on_run_task(req)))
+    t2.start()
+    time.sleep(0.1)
+    release.set()
+    t1.join(timeout=5.0)
+    t2.join(timeout=5.0)
+    assert replies == [{"result": "slow"}, {"result": "slow"}]
+
+
+def test_duplicate_of_failed_task_reraises_cached_error():
+    calls = []
+
+    def execute(req):
+        calls.append(1)
+        raise ValueError("task exploded")
+
+    w = _bare_worker(execute)
+    req = {"request_id": "rid-err"}
+    with pytest.raises(ValueError, match="task exploded"):
+        w._on_run_task(req)
+    with pytest.raises(RuntimeError, match="task exploded"):
+        w._on_run_task(req)
+    assert len(calls) == 1  # the failure is cached, not retried
+
+
+def test_tasks_without_id_bypass_dedup():
+    calls = []
+
+    def execute(req):
+        calls.append(1)
+        return {"result": len(calls)}
+
+    w = _bare_worker(execute)
+    assert w._on_run_task({})["result"] == 1
+    assert w._on_run_task({})["result"] == 2
+    assert len(calls) == 2
+
+
+def test_dedup_cache_is_bounded():
+    w = _bare_worker(lambda req: {"result": req["request_id"]})
+    for i in range(_DEDUP_CAPACITY + 50):
+        w._on_run_task({"request_id": f"rid-{i}"})
+    assert len(w._dedup) <= _DEDUP_CAPACITY
+    # oldest entries aged out; re-delivery of an evicted id re-executes
+    assert "rid-0" not in w._dedup
+    assert f"rid-{_DEDUP_CAPACITY + 49}" in w._dedup
