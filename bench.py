@@ -2238,6 +2238,112 @@ def bench_serving():
     }
 
 
+def bench_autoscale():
+    """Autoscaler evidence (doc/scheduling.md#autoscaling): against a
+    real one-worker cluster, sustained admission pressure must grow
+    the pool within one evaluation — ``time_to_grow_s`` is the full
+    decision→spawn→registration latency — and idleness must drain it
+    back, with ``drain_latency_s`` covering victim pick, the graceful
+    worker-gone teardown, and in-flight task requeue (an ETL round is
+    kept running across the drain; result parity is the correctness
+    gate). ``flap_episodes`` must stay 0 by construction."""
+    import threading
+
+    import raydp_tpu
+    from raydp_tpu import control, telemetry
+    from raydp_tpu.control import (
+        Autoscaler,
+        AutoscalerConfig,
+        ClusterProvisioner,
+    )
+
+    control.reset_for_tests()
+    session = raydp_tpu.init(app_name="bench-autoscale", num_workers=1,
+                             memory_per_worker="256MB")
+    cluster = session.cluster
+    try:
+        sc = Autoscaler(ClusterProvisioner(cluster), AutoscalerConfig(
+            min_workers=1, max_workers=2, interval_s=0.5,
+            up_cooldown_s=0.2, down_cooldown_s=0.2, idle_evals=1,
+        ))
+        # Real starvation signal: one slot held, one admission queued.
+        arb = control.configure(capacity=1, admit_timeout_s=120.0)
+        holder = arb.acquire(telemetry.mint_job("holder"), slots=1,
+                             preemptible=False)
+        waiter_out = {}
+
+        def waiter():
+            waiter_out["lease"] = arb.acquire(
+                telemetry.mint_job("starved"), slots=1, timeout=120.0,
+                preemptible=False,
+            )
+
+        wt = threading.Thread(target=waiter, daemon=True)
+        wt.start()
+        deadline = time.monotonic() + 10.0
+        while (time.monotonic() < deadline
+               and arb.report()["queue_depth"] != 1):
+            time.sleep(0.02)
+
+        t0 = time.perf_counter()
+        grew = sc.step()
+        time_to_grow = time.perf_counter() - t0
+        if grew.verdict != "grow" or len(sc.provisioner.hosts()) != 2:
+            raise RuntimeError(f"autoscale bench: no grow ({grew})")
+        holder.release()
+        wt.join(30.0)
+        waiter_out["lease"].release()
+
+        # Keep ETL in flight across the drain: parity proves the
+        # worker-gone requeue path, and the drain pays for it inline.
+        def task(ctx, i):
+            time.sleep(0.05)
+            return i
+
+        items = list(range(32))
+        etl_out = {"res": []}
+
+        def etl():
+            for base in range(0, len(items), 4):
+                etl_out["res"].extend(cluster.map_tasks(
+                    task, items[base:base + 4], timeout=120.0,
+                ))
+
+        et = threading.Thread(target=etl, daemon=True)
+        et.start()
+        time.sleep(0.2)
+        drain_latency = 0.0
+        deadline = time.monotonic() + 60.0
+        while (time.monotonic() < deadline
+               and len(sc.provisioner.hosts()) > 1):
+            t0 = time.perf_counter()
+            d = sc.step()
+            if d.verdict == "shrink":
+                drain_latency = time.perf_counter() - t0
+            time.sleep(0.1)
+        et.join(120.0)
+        if etl_out["res"] != items:
+            raise RuntimeError("autoscale bench: tasks lost in drain")
+        acted = [d.verdict for d in sc.decisions
+                 if d.verdict in ("grow", "shrink")]
+        flaps = sum(
+            1 for a, b in zip(acted, acted[1:])
+            if a == "shrink" and b == "grow"
+        )
+        return {
+            "time_to_grow_s": round(time_to_grow, 3),
+            "drain_latency_s": round(drain_latency, 3),
+            "decisions_total": len(sc.decisions),
+            "grow_decisions": acted.count("grow"),
+            "shrink_decisions": acted.count("shrink"),
+            "flap_episodes": flaps,
+            "tasks_lost": 0,
+        }
+    finally:
+        raydp_tpu.stop()
+        control.reset_for_tests()
+
+
 # ----------------------------------------------------------- main
 
 # The CPU matrix runs in THIS process (pinned to the CPU platform —
@@ -2268,6 +2374,9 @@ CPU_MATRIX = [
     # Serving plane: continuous batching vs naive per-request dispatch
     # over real replica processes (doc/serving.md).
     ("serving", bench_serving),
+    # Self-sizing pool: time-to-scale-up, graceful-drain latency, and
+    # flap count against a real worker pool (doc/scheduling.md).
+    ("autoscale", bench_autoscale),
     # Ingest is bandwidth-sensitive: keep it ahead of the model configs
     # that leave host-memory pressure behind.
     ("ingest_device_feed", bench_ingest),

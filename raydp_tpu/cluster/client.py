@@ -208,6 +208,24 @@ class _RemoteMaster:
             "master ProgressReport",
         )["report"]
 
+    def scheduler_report(self) -> dict:
+        return _retry_idempotent(
+            lambda: self._client.call("SchedulerReport", {}),
+            "master SchedulerReport",
+        )["report"]
+
+    def usage_report(self) -> dict:
+        return _retry_idempotent(
+            lambda: self._client.call("UsageReport", {}),
+            "master UsageReport",
+        )["report"]
+
+    def events_report(self, job: Optional[str] = None) -> dict:
+        return _retry_idempotent(
+            lambda: self._client.call("EventsReport", {"job": job}),
+            "master EventsReport",
+        )["report"]
+
     def mark_worker_dead(self, worker_id: str, reason: str = "") -> None:
         # Best-effort: the real master's own monitors are authoritative;
         # a client merely stops routing to the worker.
@@ -310,6 +328,36 @@ class RemoteCluster:
         except Exception:
             pass  # older master without the ProgressReport handler
         return report
+
+    def scheduler_report(self) -> Optional[dict]:
+        """The remote master's arbiter state (same shape as
+        ``Cluster.scheduler_report``). Retries through master blips —
+        a dashboard polling during a restart waits it out instead of
+        hard-failing. None against an older master without the
+        handler."""
+        try:
+            return self.master.scheduler_report()
+        except Exception:
+            return None  # older master without the SchedulerReport handler
+
+    def usage_report(self) -> Optional[dict]:
+        """Per-job usage totals folded on the cluster owner (same shape
+        as ``Cluster.usage_report``). Retries through master blips;
+        None against an older master without the handler."""
+        try:
+            return self.master.usage_report()
+        except Exception:
+            return None  # older master without the UsageReport handler
+
+    def events_report(self, job: Optional[str] = None) -> Optional[dict]:
+        """The cluster event timeline + MTTR from the master's shards
+        (same shape as ``Cluster.events_report``). Retries through
+        master blips; None against an older master without the
+        handler."""
+        try:
+            return self.master.events_report(job=job)
+        except Exception:
+            return None  # older master without the EventsReport handler
 
     def capture_profile(
         self, seconds: float = 3.0, out_dir: Optional[str] = None

@@ -104,6 +104,9 @@ class AppMaster:
             "MetricsSnapshot": self._on_metrics_snapshot,
             "HealthReport": self._on_health_report,
             "ProgressReport": self._on_progress_report,
+            "SchedulerReport": self._on_scheduler_report,
+            "UsageReport": self._on_usage_report,
+            "EventsReport": self._on_events_report,
             "Ping": lambda req: {"pong": True, "namespace": self.namespace},
         }
         # The master doubles as the driver node's store agent (no extra
@@ -329,6 +332,38 @@ class AppMaster:
 
     def _on_progress_report(self, req: dict) -> dict:
         return {"report": self.progress_report()}
+
+    def _on_scheduler_report(self, req: dict) -> dict:
+        return {"report": self.scheduler_report()}
+
+    def _on_usage_report(self, req: dict) -> dict:
+        return {"report": self.usage_report()}
+
+    def _on_events_report(self, req: dict) -> dict:
+        return {"report": self.events_report(job=req.get("job"))}
+
+    def scheduler_report(self) -> dict:
+        """The master-process arbiter's state (the master and the
+        cluster owner share a process, so this is the authoritative
+        view client sessions poll)."""
+        from raydp_tpu.control import get_arbiter
+
+        return get_arbiter().report()
+
+    def usage_report(self) -> dict:
+        """Per-job usage totals folded from the merged cluster view."""
+        from raydp_tpu.telemetry import accounting as _acct
+
+        return _acct.usage_report(self.metrics_snapshot())
+
+    def events_report(self, job: Optional[str] = None) -> dict:
+        """The cluster event timeline + MTTR report, from the master's
+        telemetry-dir shards (or its in-memory ring)."""
+        from raydp_tpu.telemetry import events as _events
+        from raydp_tpu.telemetry import telemetry_dir
+
+        records = _events.load_event_records(telemetry_dir(), job=job)
+        return {"events": records, "mttr": _events.mttr_report(records)}
 
     def progress_report(self) -> dict:
         """Live stage progress: the driver-process tracker (DataFrame

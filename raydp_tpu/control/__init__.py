@@ -1,7 +1,9 @@
-"""Multi-tenant control plane: admission, fair share, preemption.
+"""Multi-tenant control plane: admission, fair share, preemption,
+and telemetry-driven pool sizing.
 
-Public surface of :mod:`raydp_tpu.control.arbiter` — see
-``doc/scheduling.md`` for the state machine and semantics.
+Public surface of :mod:`raydp_tpu.control.arbiter` and
+:mod:`raydp_tpu.control.autoscaler` — see ``doc/scheduling.md`` for
+the state machines and semantics.
 """
 from raydp_tpu.control.arbiter import (
     SCHED_ADMIT_TIMEOUT_ENV,
@@ -18,6 +20,16 @@ from raydp_tpu.control.arbiter import (
     reset_for_tests,
     stage_gate,
 )
+from raydp_tpu.control.autoscaler import (
+    AUTOSCALE_MAX_ENV,
+    AUTOSCALE_MIN_ENV,
+    Autoscaler,
+    AutoscalerConfig,
+    ClusterProvisioner,
+    Decision,
+    HostProvisioner,
+    ProvisionerError,
+)
 
 __all__ = [
     "SCHED_ADMIT_TIMEOUT_ENV",
@@ -26,9 +38,17 @@ __all__ = [
     "SCHED_MAX_QUEUE_ENV",
     "SCHED_PREEMPT_TIMEOUT_ENV",
     "SCHED_PRESSURE_ENV",
+    "AUTOSCALE_MAX_ENV",
+    "AUTOSCALE_MIN_ENV",
     "ClusterArbiter",
     "ClusterBusyError",
     "Lease",
+    "Autoscaler",
+    "AutoscalerConfig",
+    "ClusterProvisioner",
+    "Decision",
+    "HostProvisioner",
+    "ProvisionerError",
     "configure",
     "get_arbiter",
     "reset_for_tests",

@@ -442,6 +442,7 @@ class ClusterArbiter:
                 ],
                 "states": dict(self._states),
                 "wait_p50_s": round(p50, 4),
+                "wait_oldest_s": self._oldest_wait_locked(),
                 "eta_s": self._eta_locked(),
             }
 
@@ -621,6 +622,21 @@ class ClusterArbiter:
 
     def _publish_depth_locked(self) -> None:
         _metrics.gauge_set("sched/queue_depth", float(len(self._waiters)))
+        _metrics.gauge_set(
+            "sched/queue_wait_oldest", self._oldest_wait_locked()
+        )
+
+    def _oldest_wait_locked(self) -> float:
+        """Age in seconds of the longest-queued waiter (0.0 when the
+        queue is empty) — the starvation signal the autoscaler keys on:
+        depth alone cannot distinguish a deep fast-moving queue from a
+        shallow stuck one."""
+        if not self._waiters:
+            return 0.0
+        now = time.monotonic()
+        return round(
+            max(now - w.enqueued_mono for w in self._waiters), 4
+        )
 
     def _set_state_locked(self, job: _acct.JobContext, state: str) -> None:
         self._states[job.job_id] = state

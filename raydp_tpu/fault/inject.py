@@ -40,11 +40,21 @@ class PreemptionError(RuntimeError):
         self.checkpoint_path = checkpoint_path
 
 
+class SpawnFaultError(RuntimeError):
+    """Raised by :func:`on_spawn` for an armed ``spawn_fail`` clause.
+
+    The autoscaler's provisioner boundary catches it and applies the
+    backoff-and-retry budget, exactly as it would a real launcher
+    failure.
+    """
+
+
 class _State:
     def __init__(self) -> None:
         self.plan_text: Optional[str] = None
         self.clauses: List[FaultClause] = []
         self.rpc_counts: dict = {}
+        self.spawn_count = 0
         self.preempt = threading.Event()
         self.drained = threading.Event()
         self.grace_timer: Optional[threading.Timer] = None
@@ -100,6 +110,7 @@ def _clauses() -> List[FaultClause]:
             _state.clauses = parse_plan(text, seed=seed)
             _state.plan_text = text
             _state.rpc_counts = {}
+            _state.spawn_count = 0
         return _state.clauses
 
 
@@ -256,6 +267,33 @@ def on_rpc(qualified_method: str) -> Optional[str]:
     return verdict
 
 
+def on_spawn() -> None:
+    """Hook before each host-spawn attempt at the provisioner boundary.
+
+    Counts attempts per process (0-based). A matching ``spawn_delay``
+    clause sleeps in place (hung cloud-provisioning call); a matching
+    ``spawn_fail`` clause raises :class:`SpawnFaultError`, which the
+    autoscaler treats as a provisioner failure to back off and retry.
+    """
+    clauses = _clauses()
+    if not clauses:
+        return
+    with _lock:
+        n = _state.spawn_count
+        _state.spawn_count = n + 1
+    for c in clauses:
+        if not c.armed or c.fired or c.nth != n:
+            continue
+        if c.kind == "spawn_delay":
+            c.fired = True
+            _emit_clause(c, f"delayed spawn attempt {n} by {c.delay}s")
+            time.sleep(c.delay)
+        elif c.kind == "spawn_fail":
+            c.fired = True
+            _emit_clause(c, f"failed spawn attempt {n}")
+            raise SpawnFaultError(f"injected spawn failure (attempt {n})")
+
+
 def on_heartbeat(
     beat_index: int, rank: Optional[int] = None, worker: Optional[str] = None
 ) -> bool:
@@ -361,6 +399,7 @@ def reset_for_tests() -> None:
         _state.plan_text = None
         _state.clauses = []
         _state.rpc_counts = {}
+        _state.spawn_count = 0
         _state.preempt = threading.Event()
         _state.drained = threading.Event()
         if _state.grace_timer is not None:

@@ -49,6 +49,15 @@ Kinds and their keys (see ``doc/fault_tolerance.md`` for semantics):
     serving replica (0-based, per process) stalls ``S`` seconds before
     running, simulating a straggler batch; ``replica=N`` restricts the
     stall to one replica.
+``spawn_fail``
+    ``nth=K[,prob=P]`` — the ``K``-th host-spawn attempt (0-based,
+    counted per process at the autoscaler's provisioner boundary)
+    raises a provisioner error instead of launching, exercising the
+    backoff-and-retry budget deterministically.
+``spawn_delay``
+    ``nth=K,delay=S`` — the ``K``-th host-spawn attempt stalls ``S``
+    seconds before proceeding, simulating a hung cloud-provisioning
+    call.
 
 Any clause may carry ``prob=P`` (0..1): whether it arms is decided
 once, deterministically, from ``RAYDP_TPU_FAULT_SEED`` and the clause
@@ -66,7 +75,7 @@ FAULT_SEED_ENV = "RAYDP_TPU_FAULT_SEED"
 
 _KINDS = (
     "kill", "preempt", "rpc_delay", "rpc_drop", "hb_stall",
-    "serve_kill", "latency",
+    "serve_kill", "latency", "spawn_fail", "spawn_delay",
 )
 
 _REQUIRED: Dict[str, tuple] = {
@@ -75,6 +84,8 @@ _REQUIRED: Dict[str, tuple] = {
     "hb_stall": ("beats",),
     "serve_kill": ("replica", "request"),
     "latency": ("nth", "delay"),
+    "spawn_fail": ("nth",),
+    "spawn_delay": ("nth", "delay"),
 }
 
 _ALLOWED: Dict[str, tuple] = {
@@ -85,6 +96,8 @@ _ALLOWED: Dict[str, tuple] = {
     "hb_stall": ("rank", "worker", "beats", "after", "prob"),
     "serve_kill": ("replica", "request", "code", "prob"),
     "latency": ("nth", "delay", "replica", "prob"),
+    "spawn_fail": ("nth", "prob"),
+    "spawn_delay": ("nth", "delay", "prob"),
 }
 
 _INT_KEYS = (

@@ -435,6 +435,25 @@ class ReplicaGroup:
             "per_replica": per_replica,
         }
 
+    def drain_replica(self, index: int) -> bool:
+        """Migrate one replica's work to its surviving siblings.
+
+        The autoscaler's serve-drain hook: terminating the replica
+        process routes any in-flight batch through the dispatcher's
+        requeue path (back to the queue *head*, picked up by another
+        lineage — zero drops), after which the slot's supervisor
+        respawns the lineage as usual. Returns False when the index is
+        unknown or the replica is not currently running.
+        """
+        if not self._started or not 0 <= index < len(self._slots):
+            return False
+        slot = self._slots[index]
+        if slot.proc is None or slot.proc.poll() is not None:
+            return False
+        _events.emit("serve/drain", group=self.label, replica=index)
+        slot.proc.terminate()
+        return True
+
     # -- shutdown -------------------------------------------------------
 
     def stop(self) -> None:

@@ -459,6 +459,38 @@ def render_prometheus(view: Dict[str, Any]) -> str:
         "Admissions rejected with ClusterBusyError by the load-shedding "
         "cap (queue at RAYDP_TPU_SCHED_MAX_QUEUE or explicit shed mode).",
     )
+    sched_wait_oldest = _Family(
+        "raydp_sched_queue_wait_oldest_seconds", "gauge",
+        "Age of the longest-queued admission waiter (0 when the queue "
+        "is empty) — the starvation signal the autoscaler reads.",
+    )
+    autoscale_decisions = _Family(
+        "raydp_autoscale_decisions_total", "counter",
+        "Autoscaler scale actions by kind (kind=grow|shrink|binpack; "
+        "doc/scheduling.md, Autoscaling).",
+    )
+    autoscale_pool_size = _Family(
+        "raydp_autoscale_pool_size", "gauge",
+        "Worker-pool size as last observed by the autoscaler loop.",
+    )
+    autoscale_pending = _Family(
+        "raydp_autoscale_pending_spawns", "gauge",
+        "Hosts requested from the provisioner but not yet confirmed up.",
+    )
+    autoscale_drains = _Family(
+        "raydp_autoscale_drains_total", "counter",
+        "Hosts drained as graceful scale-down victims.",
+    )
+    autoscale_spawn_failures = _Family(
+        "raydp_autoscale_spawn_failures_total", "counter",
+        "Provisioner spawn attempts that failed; each burns one retry "
+        "from the RAYDP_TPU_AUTOSCALE_SPAWN_RETRIES budget.",
+    )
+    autoscale_denied = _Family(
+        "raydp_autoscale_denied_total", "counter",
+        "Scale decisions denied by cooldown, gang floor, or a missing "
+        "victim — the anti-flap machinery holding the line.",
+    )
     serve_requests = _Family(
         "raydp_serve_requests_total", "counter",
         "Requests accepted into the serving queue (doc/serving.md).",
@@ -710,6 +742,28 @@ def render_prometheus(view: Dict[str, Any]) -> str:
                     if name == "sched/sheds":
                         sched_sheds.add({"worker": worker_id}, section[name])
                         continue
+                    if name.startswith("autoscale/decisions/"):
+                        autoscale_decisions.add(
+                            {"worker": worker_id,
+                             "kind": name[len("autoscale/decisions/"):]},
+                            section[name],
+                        )
+                        continue
+                    if name == "autoscale/drains":
+                        autoscale_drains.add(
+                            {"worker": worker_id}, section[name]
+                        )
+                        continue
+                    if name == "autoscale/spawn_failed":
+                        autoscale_spawn_failures.add(
+                            {"worker": worker_id}, section[name]
+                        )
+                        continue
+                    if name == "autoscale/denied":
+                        autoscale_denied.add(
+                            {"worker": worker_id}, section[name]
+                        )
+                        continue
                     if name in ("serve/requests", "serve/replies",
                                 "serve/errors", "serve/rejected",
                                 "serve/requeued", "serve/dup_replies",
@@ -748,6 +802,12 @@ def render_prometheus(view: Dict[str, Any]) -> str:
                         )
                     elif name == "sched/queue_depth":
                         sched_queue_depth.add({"worker": worker_id}, value)
+                    elif name == "sched/queue_wait_oldest":
+                        sched_wait_oldest.add({"worker": worker_id}, value)
+                    elif name == "autoscale/pool_size":
+                        autoscale_pool_size.add({"worker": worker_id}, value)
+                    elif name == "autoscale/pending_spawns":
+                        autoscale_pending.add({"worker": worker_id}, value)
                     elif name == "serve/queue_depth":
                         serve_queue_depth.add({"worker": worker_id}, value)
                     elif name == "serve/batch_fill":
@@ -832,7 +892,10 @@ def render_prometheus(view: Dict[str, Any]) -> str:
                    job_bytes, job_hbm_byte_seconds, job_compile_seconds,
                    job_counter,
                    sched_queue_depth, sched_preemptions, sched_wait,
-                   sched_sheds,
+                   sched_sheds, sched_wait_oldest,
+                   autoscale_decisions, autoscale_pool_size,
+                   autoscale_pending, autoscale_drains,
+                   autoscale_spawn_failures, autoscale_denied,
                    serve_requests, serve_replies, serve_errors,
                    serve_rejected, serve_requeued, serve_dup_replies,
                    serve_restarts, serve_batches, serve_batch_requests,

@@ -411,6 +411,128 @@ fill = snap["serve/batch_requests"] / (snap["serve/batches"] * 4)
 assert fill > 0.5, (fill, snap)
 PYEOF
 fi
+# Autoscale smoke (HARD): sustained admission pressure grows a real
+# worker pool within ONE evaluation, the injected spawn_fail:nth=1 is
+# backed off and retried to convergence, idle drains the pool back to
+# min_workers with zero flap episodes (every grow strictly precedes
+# every shrink), a scale-down mid-ETL loses no tasks (result parity),
+# and every decision is reconstructible from autoscale/* events via
+# the timeline CLI — the end-to-end proof of doc/scheduling.md's
+# autoscaling story.
+if [ "$rc" -eq 0 ]; then
+  echo "--- autoscale smoke (pressure grow / chaos spawn / graceful drain) ---"
+  as_dir=$(mktemp -d)
+  JAX_PLATFORMS=cpu RAYDP_TPU_TELEMETRY_DIR="$as_dir" \
+    RAYDP_TPU_FAULT_PLAN="spawn_fail:nth=1" python - <<'PYEOF' \
+    && as_tl=$(JAX_PLATFORMS=cpu python -m raydp_tpu.telemetry.events "$as_dir") \
+    && grep -q "autoscale/decision" <<<"$as_tl" \
+    && grep -q "autoscale/spawn_failed" <<<"$as_tl" \
+    && echo "AUTOSCALE_SMOKE=ok" || { echo "AUTOSCALE_SMOKE=failed"; rc=1; }
+import threading
+import time
+
+import raydp_tpu
+from raydp_tpu import control, telemetry
+from raydp_tpu.control import (
+    Autoscaler,
+    AutoscalerConfig,
+    ClusterProvisioner,
+)
+from raydp_tpu.telemetry import events as events_mod
+from raydp_tpu.utils.profiling import metrics
+
+session = raydp_tpu.init(app_name="autoscale-smoke", num_workers=1,
+                         memory_per_worker="256MB")
+cluster = session.cluster
+sc = Autoscaler(ClusterProvisioner(cluster), AutoscalerConfig(
+    min_workers=1, max_workers=3, interval_s=0.5, up_cooldown_s=0.3,
+    down_cooldown_s=0.6, idle_evals=2, spawn_retries=3, backoff_s=0.2,
+))
+
+# -- phase 1: sustained admission pressure -> grow within ONE eval.
+arb = control.configure(capacity=1, admit_timeout_s=120.0)
+holder = arb.acquire(telemetry.mint_job("holder"), slots=1,
+                     preemptible=False)
+waiter_out = {}
+
+
+def waiter():
+    waiter_out["lease"] = arb.acquire(
+        telemetry.mint_job("starved"), slots=1, timeout=120.0,
+        preemptible=False,
+    )
+
+
+wt = threading.Thread(target=waiter, daemon=True)
+wt.start()
+deadline = time.monotonic() + 10.0
+while time.monotonic() < deadline and arb.report()["queue_depth"] != 1:
+    time.sleep(0.02)
+assert arb.report()["queue_depth"] == 1, arb.report()
+
+d = sc.step()  # one evaluation under pressure must already grow
+assert d.verdict == "grow", d
+assert len(cluster.alive_workers()) == 2
+
+# -- phase 2: second grow trips spawn_fail:nth=1 -> backoff, retry,
+# converge (chaos-hardened provisioning).
+time.sleep(0.35)  # clear the up-cooldown
+d = sc.step()
+assert d.verdict == "grow", d
+assert len(cluster.alive_workers()) == 3
+snap = metrics.snapshot()["counters"]
+assert snap.get("autoscale/spawn_failed", 0) == 1, snap
+
+holder.release()
+wt.join(30.0)
+waiter_out["lease"].release()
+
+
+# -- phase 3: scale-down mid-ETL loses no tasks (result parity).
+def task(ctx, i):
+    time.sleep(0.15)
+    return i
+
+
+items = list(range(96))
+etl_out = {"res": []}
+
+
+def etl():
+    for base in range(0, len(items), 8):  # sequential rounds keep the
+        etl_out["res"].extend(            # job in flight across drains
+            cluster.map_tasks(task, items[base:base + 8], timeout=120.0)
+        )
+
+
+et = threading.Thread(target=etl, daemon=True)
+et.start()
+time.sleep(0.3)  # tasks in flight on all three workers
+deadline = time.monotonic() + 60.0
+while time.monotonic() < deadline and len(cluster.alive_workers()) > 1:
+    sc.step()
+    time.sleep(0.25)
+assert len(cluster.alive_workers()) == 1, cluster.alive_workers()
+et.join(180.0)
+assert etl_out["res"] == items, "tasks lost in scale-down"
+
+# -- phase 4: zero flap episodes — all grows strictly precede all
+# shrinks in the decision record.
+acted = [d.verdict for d in sc.decisions
+         if d.verdict in ("grow", "shrink")]
+assert acted == ["grow", "grow", "shrink", "shrink"], acted
+
+# -- phase 5: every non-steady decision is on the event timeline.
+decided = [r for r in events_mod.local_events()
+           if r["name"] == "autoscale/decision"]
+assert len(decided) == len(
+    [d for d in sc.decisions if d.verdict != "steady"]
+), (len(decided), [d.verdict for d in sc.decisions])
+
+raydp_tpu.stop()
+PYEOF
+  rm -rf "$as_dir"
+fi
 # Bench regression gate (ADVISORY): when two result files exist, diff
 # the newest pair; a >10% throughput/MFU regression prints loudly but
 # never fails the tier-1 gate (bench noise on shared CI boxes is real

@@ -230,6 +230,43 @@ def test_lease_ttl_reclaims_unrenewed_lease():
     got.release()
 
 
+def test_ttl_reap_racing_release_does_not_double_free():
+    # A lease can die twice: the TTL reaper (triggered inside a
+    # concurrent acquire) and the holder's own release() racing each
+    # other. Both paths must agree on exactly one slot return — a
+    # double-free would inflate capacity and over-admit forever after.
+    arb = control.configure(
+        capacity=2, admit_timeout_s=10.0, lease_ttl_s=0.2
+    )
+    for _ in range(5):
+        stale = arb.acquire(
+            acct.mint_job("stale"), slots=2, preemptible=False
+        )
+        time.sleep(0.3)  # past TTL, reaper not yet triggered
+        barrier = threading.Barrier(2)
+
+        def racer(lease=stale, gate=barrier):
+            gate.wait()
+            lease.release()
+
+        t = threading.Thread(target=racer, daemon=True)
+        t.start()
+        barrier.wait()
+        # this acquire runs _reap_expired_locked concurrently with the
+        # holder's release(); only one of them may free the slots
+        got = arb.acquire(
+            acct.mint_job("next"), slots=2, timeout=5.0, preemptible=False
+        )
+        t.join(5.0)
+        rep = arb.report()
+        assert rep["capacity"] == 2 and rep["in_use"] == 2
+        # if both frees had landed, this over-wide acquire would fit
+        with pytest.raises(ClusterBusyError):
+            arb.acquire(acct.mint_job("extra"), slots=1, timeout=0.05)
+        got.release()
+        assert arb.in_use() == 0
+
+
 def test_stage_gate_turns_are_reentrant_and_leaseholder_passthrough():
     arb = control.configure(capacity=1, admit_timeout_s=5.0)
     job = acct.mint_job("etl")
