@@ -1823,6 +1823,105 @@ def bench_job_accounting():
     return out
 
 
+# ----------------------------------------------------------- observability
+
+def bench_observability():
+    """Observability-plane overhead evidence (doc/telemetry.md "SLO
+    engine & dashboard"): the same host-side fit with the time-series
+    sampler + SLO engine live at an aggressive 20 Hz cadence vs the
+    same threads kill-switched (``RAYDP_TPU_TIMESERIES=0`` /
+    ``RAYDP_TPU_SLO=0`` — each tick no-ops, isolating the sampling
+    work itself) — interleaved runs + medians, same discipline as
+    ``stage_stats_overhead``; budget <5%. Also stamps the store
+    footprint and the latency of building + rendering the unified
+    dashboard document over the populated registry."""
+    import pandas as pd
+
+    from raydp_tpu.models.mlp import MLP
+    from raydp_tpu.telemetry import dashboard as _dash
+    from raydp_tpu.telemetry.slo import SloConfig, SloEngine
+    from raydp_tpu.telemetry.timeseries import (
+        TimeSeriesConfig,
+        TimeSeriesSampler,
+    )
+    from raydp_tpu.train.estimator import JAXEstimator
+
+    n_rows, n_feat, batch = 16_384, 14, 256
+    rs = np.random.RandomState(13)
+    x = rs.rand(n_rows, n_feat).astype(np.float32)
+    w = rs.rand(n_feat, 1).astype(np.float32)
+    cols = [f"f{i}" for i in range(n_feat)]
+    df = pd.DataFrame(x, columns=cols)
+    df["label"] = (x @ w).astype(np.float32)
+
+    def one_fit():
+        est = JAXEstimator(
+            model=MLP(hidden=(64, 32), out_dim=1),
+            loss="mse",
+            num_epochs=1,
+            batch_size=batch,
+            feature_columns=cols,
+            label_column="label",
+            epoch_mode="stream",
+        )
+        t0 = time.perf_counter()
+        est.fit_on_df(df)
+        return time.perf_counter() - t0
+
+    def timed_fit(kill_switched):
+        if kill_switched:
+            os.environ["RAYDP_TPU_TIMESERIES"] = "0"
+            os.environ["RAYDP_TPU_SLO"] = "0"
+        sampler = TimeSeriesSampler(config=TimeSeriesConfig(
+            interval_s=0.05, capacity=512, max_series=1024,
+        )).start()
+        engine = SloEngine(
+            store=sampler.store,
+            config=SloConfig(interval_s=0.05),
+        ).start()
+        try:
+            dt = one_fit()
+        finally:
+            engine.stop()
+            sampler.stop()
+            os.environ.pop("RAYDP_TPU_TIMESERIES", None)
+            os.environ.pop("RAYDP_TPU_SLO", None)
+        return dt, sampler
+
+    one_fit()  # warm the jit caches both arms share
+    ons, offs = [], []
+    store_stats = None
+    for i in range(10):
+        if i % 2 == 0:
+            dt, sampler = timed_fit(kill_switched=False)
+            ons.append(dt)
+            store_stats = sampler.store.stats()
+        else:
+            offs.append(timed_fit(kill_switched=True)[0])
+    ons.sort(), offs.sort()
+    on_s, off_s = ons[len(ons) // 2], offs[len(offs) // 2]
+
+    t0 = time.perf_counter()
+    dash = _dash.local_dashboard()
+    _dash.format_dashboard(dash)
+    dash_ms = (time.perf_counter() - t0) * 1e3
+    return {
+        "samples_per_sec": round(n_rows / on_s, 1),
+        "unit": "samples/s",
+        "enabled_s": round(on_s, 4),
+        "disabled_s": round(off_s, 4),
+        "overhead_frac": round(
+            (on_s - off_s) / off_s if off_s else 0.0, 4
+        ),
+        "baseline": "same fit, sampler+engine kill-switched via env",
+        "dashboard_build_ms": round(dash_ms, 2),
+        "store_series": (store_stats or {}).get("series"),
+        "store_memory_bytes_est": (store_stats or {}).get(
+            "memory_bytes_est"
+        ),
+    }
+
+
 def bench_fault_tolerance():
     """Recovery-cost evidence (doc/fault_tolerance.md): the same tiny
     supervised ``fit_spmd`` run twice — clean, then with an injected
@@ -2365,6 +2464,9 @@ CPU_MATRIX = [
     # Job-accounting-plane overhead + per-job attribution evidence
     # (host-side ETL under an explicit job scope).
     ("job_accounting", bench_job_accounting),
+    # Time-series sampler + SLO engine overhead vs kill-switched
+    # baseline, plus dashboard build latency (host-side fit).
+    ("observability", bench_observability),
     # Recovery cost (MTTR) of the supervised gang under an injected
     # rank kill; host-side, loss parity is the correctness gate.
     ("fault_tolerance", bench_fault_tolerance),

@@ -226,6 +226,12 @@ class _RemoteMaster:
             "master EventsReport",
         )["report"]
 
+    def dashboard_report(self) -> dict:
+        return _retry_idempotent(
+            lambda: self._client.call("DashboardReport", {}),
+            "master DashboardReport",
+        )["report"]
+
     def mark_worker_dead(self, worker_id: str, reason: str = "") -> None:
         # Best-effort: the real master's own monitors are authoritative;
         # a client merely stops routing to the worker.
@@ -358,6 +364,16 @@ class RemoteCluster:
             return self.master.events_report(job=job)
         except Exception:
             return None  # older master without the EventsReport handler
+
+    def dashboard_report(self) -> Optional[dict]:
+        """The unified flywheel dashboard rendered on the cluster owner
+        (same shape as ``Cluster.dashboard_report``). Retries through
+        master blips; None against an older master without the
+        handler."""
+        try:
+            return self.master.dashboard_report()
+        except Exception:
+            return None  # older master without the DashboardReport handler
 
     def capture_profile(
         self, seconds: float = 3.0, out_dir: Optional[str] = None

@@ -99,6 +99,8 @@ class Cluster:
         self._elastic_thread: Optional[threading.Thread] = None
         self._trace_ctx = None
         self._metrics_server = None
+        self._ts_sampler = None
+        self._slo_engine = None
         self._log_dir = os.path.join(
             "/tmp/raydp_tpu", f"{_slug(config.app_name)}-{os.getpid()}"
         )
@@ -171,6 +173,7 @@ class Cluster:
         self._elastic_thread.start()
         self._warm_workers_async()
         self._serve_metrics()
+        self._start_observability()
 
     def _serve_metrics(self) -> None:
         """Expose the merged Prometheus view at ``/metrics`` when
@@ -188,6 +191,9 @@ class Cluster:
                 # /debug/profile?seconds=N → cluster-wide gang capture,
                 # not just the driver process.
                 profile=lambda seconds: self.capture_profile(seconds) or {},
+                # /debug/dashboard → the merged flywheel view, not just
+                # the driver registry.
+                dashboard=self.dashboard_report,
             )
             logger.info(
                 "prometheus scrape endpoint on :%d/metrics",
@@ -195,6 +201,27 @@ class Cluster:
             )
         except Exception:
             logger.exception("metrics endpoint failed to start")
+
+    def _start_observability(self) -> None:
+        """Arm the driver-side time-series sampler over the merged view
+        and the SLO engine over its store. Both are kill-switched
+        (``RAYDP_TPU_TIMESERIES=0`` / ``RAYDP_TPU_SLO=0``) and cheap:
+        one snapshot fold per sampling interval. Best-effort — the
+        observability plane must never fail cluster start."""
+        from raydp_tpu.telemetry import slo as _slo
+        from raydp_tpu.telemetry import timeseries as _ts
+
+        try:
+            if _ts.timeseries_enabled():
+                self._ts_sampler = _ts.TimeSeriesSampler(
+                    snapshot_fn=self.metrics_snapshot
+                ).start()
+            if _slo.slo_enabled() and self._ts_sampler is not None:
+                self._slo_engine = _slo.SloEngine(
+                    store=self._ts_sampler.store
+                ).start()
+        except Exception:  # pragma: no cover - observer, never fatal
+            logger.exception("observability plane failed to start")
 
     def _warm_workers_async(self) -> None:
         """Pre-import the ETL stack on every worker in the background.
@@ -464,6 +491,14 @@ class Cluster:
                 self._stop_worker(worker_id, kill_objects=False)
             self._flush_telemetry()
         self._pool.shutdown(wait=False)
+        for attr in ("_slo_engine", "_ts_sampler"):
+            plane = getattr(self, attr)
+            if plane is not None:
+                try:
+                    plane.stop()
+                except Exception:  # pragma: no cover - teardown best-effort
+                    pass
+                setattr(self, attr, None)
         if self._metrics_server is not None:
             try:
                 self._metrics_server.close()
@@ -671,6 +706,26 @@ class Cluster:
 
         records = _events.load_event_records(telemetry_dir(), job=job)
         return {"events": records, "mttr": _events.mttr_report(records)}
+
+    def dashboard_report(self) -> dict:
+        """The unified flywheel dashboard: train/ETL/serve/control
+        sections folded from the merged view, the SLO status table, the
+        event timeline tail + MTTR episodes, and per-job usage — one
+        document (see :mod:`raydp_tpu.telemetry.dashboard`). Also
+        served at ``/debug/dashboard`` and, in client mode, over the
+        ``DashboardReport`` RPC."""
+        from raydp_tpu.telemetry import dashboard as _dash
+        from raydp_tpu.telemetry import events as _events
+        from raydp_tpu.telemetry import telemetry_dir
+
+        records = _events.load_event_records(telemetry_dir())
+        try:
+            scheduler = self.scheduler_report()
+        except Exception:
+            scheduler = None
+        return _dash.build(
+            self.metrics_snapshot(), scheduler=scheduler, events=records
+        )
 
     def health_report(self) -> Optional[dict]:
         """Aggregated cluster health (parity with :meth:`trace_report`):

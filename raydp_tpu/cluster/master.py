@@ -107,6 +107,7 @@ class AppMaster:
             "SchedulerReport": self._on_scheduler_report,
             "UsageReport": self._on_usage_report,
             "EventsReport": self._on_events_report,
+            "DashboardReport": self._on_dashboard_report,
             "Ping": lambda req: {"pong": True, "namespace": self.namespace},
         }
         # The master doubles as the driver node's store agent (no extra
@@ -342,6 +343,9 @@ class AppMaster:
     def _on_events_report(self, req: dict) -> dict:
         return {"report": self.events_report(job=req.get("job"))}
 
+    def _on_dashboard_report(self, req: dict) -> dict:
+        return {"report": self.dashboard_report()}
+
     def scheduler_report(self) -> dict:
         """The master-process arbiter's state (the master and the
         cluster owner share a process, so this is the authoritative
@@ -364,6 +368,23 @@ class AppMaster:
 
         records = _events.load_event_records(telemetry_dir(), job=job)
         return {"events": records, "mttr": _events.mttr_report(records)}
+
+    def dashboard_report(self) -> dict:
+        """The unified flywheel dashboard over the merged cluster view
+        (train/ETL/serve/control sections + SLO status + event
+        timeline; see :mod:`raydp_tpu.telemetry.dashboard`)."""
+        from raydp_tpu.telemetry import dashboard as _dash
+        from raydp_tpu.telemetry import events as _events
+        from raydp_tpu.telemetry import telemetry_dir
+
+        records = _events.load_event_records(telemetry_dir())
+        try:
+            scheduler = self.scheduler_report()
+        except Exception:
+            scheduler = None
+        return _dash.build(
+            self.metrics_snapshot(), scheduler=scheduler, events=records
+        )
 
     def progress_report(self) -> dict:
         """Live stage progress: the driver-process tracker (DataFrame

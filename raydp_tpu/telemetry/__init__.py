@@ -52,6 +52,17 @@ without pulling in jax):
   sentinel trips) served at ``/debug/events`` and merged into the
   Perfetto trace (``python -m raydp_tpu.telemetry.events <dir>``).
 
+* :mod:`~raydp_tpu.telemetry.timeseries` /
+  :mod:`~raydp_tpu.telemetry.slo` /
+  :mod:`~raydp_tpu.telemetry.dashboard` — the observability control
+  plane: a driver-side bounded time-series store sampled from the
+  merged registry at fixed cadence, declarative SLO objectives
+  evaluated as multi-window burn rates (breach/recovery hysteresis,
+  ``slo/breach`` auto-triage events, ``raydp_slo_*`` families), and
+  the unified flywheel dashboard (``/debug/dashboard``,
+  ``Cluster.dashboard_report()``,
+  ``python -m raydp_tpu.telemetry.dashboard``).
+
 Drivers pull the live aggregate with ``Cluster.metrics_snapshot()``
 (works identically through ``raydp_tpu.connect`` client sessions).
 See ``doc/telemetry.md``.
@@ -73,11 +84,14 @@ from raydp_tpu.telemetry.export import (
 )
 from raydp_tpu.telemetry import (
     accounting,
+    dashboard,
     device_profiler,
     events,
     flight_recorder,
     logs,
     progress,
+    slo,
+    timeseries,
     watchdog,
 )
 from raydp_tpu.telemetry.accounting import (
@@ -135,7 +149,15 @@ from raydp_tpu.telemetry.propagation import (
     to_traceparent,
 )
 from raydp_tpu.telemetry.shipping import ClusterTelemetry, MetricsShipper
+from raydp_tpu.telemetry.slo import Objective, SloConfig, SloEngine
 from raydp_tpu.telemetry.spans import Span, SpanRecorder, event, recorder, span
+from raydp_tpu.telemetry.timeseries import (
+    TIMESERIES_ENV,
+    TimeSeriesConfig,
+    TimeSeriesSampler,
+    TimeSeriesStore,
+    timeseries_enabled,
+)
 
 __all__ = [
     "Span",
@@ -159,6 +181,17 @@ __all__ = [
     "device_profiler",
     "accounting",
     "events",
+    "dashboard",
+    "slo",
+    "timeseries",
+    "TIMESERIES_ENV",
+    "TimeSeriesConfig",
+    "TimeSeriesStore",
+    "TimeSeriesSampler",
+    "timeseries_enabled",
+    "Objective",
+    "SloConfig",
+    "SloEngine",
     "JobContext",
     "current_job",
     "job_scope",

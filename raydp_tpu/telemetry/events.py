@@ -74,10 +74,11 @@ _seq = itertools.count(1)
 #: causal repair chain the MTTR breakdown itemizes.
 FAILURE_KINDS = frozenset(
     {"rank/dead", "worker/dead", "gang/failed", "preempt/request",
-     "sched/preempt"}
+     "sched/preempt", "slo/breach"}
 )
 RECOVERY_KINDS = frozenset(
-    {"train/resume", "worker/restart", "gang/launch", "sched/resume"}
+    {"train/resume", "worker/restart", "gang/launch", "sched/resume",
+     "slo/recovered"}
 )
 
 
@@ -117,8 +118,21 @@ def emit(
     }
     if not _acct.accounting_enabled():
         return rec
+    evicted = False
     with _mu:
+        if _ring.maxlen is not None and len(_ring) == _ring.maxlen:
+            evicted = True
         _ring.append(rec)
+    if evicted:
+        # Count outside the ring lock; ships on heartbeats as
+        # raydp_events_dropped_total so ring evictions are never silent
+        # (mirrors the span-recorder drop accounting).
+        try:
+            from raydp_tpu.utils.profiling import metrics
+
+            metrics.counter_add("events/dropped")
+        except Exception:  # pragma: no cover - accounting best-effort
+            pass
     try:
         _write_through(rec)
     except Exception:  # the timeline must never sink the workload
@@ -313,12 +327,18 @@ def main(argv: Optional[List[str]] = None) -> int:
               file=sys.stderr)
         return 2
     events = load_event_records(directory, job=args.job)
-    if args.json:
-        print(json.dumps(
-            {"events": events, "mttr": mttr_report(events)}, default=str
-        ))
-    else:
-        print(format_timeline(events))
+    try:
+        if args.json:
+            print(json.dumps(
+                {"events": events, "mttr": mttr_report(events)}, default=str
+            ))
+        else:
+            print(format_timeline(events))
+    except BrokenPipeError:
+        # Downstream consumer (e.g. `| grep -q` under pipefail) closed
+        # the pipe after finding what it wanted; redirect stdout to
+        # devnull so the interpreter's shutdown flush stays quiet.
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
     return 0
 
 

@@ -558,6 +558,27 @@ def render_prometheus(view: Dict[str, Any]) -> str:
         "raydp_serve_replica_latency_seconds", "summary",
         "Per-replica ExecuteBatch wall time, labelled by replica index.",
     )
+    events_dropped = _Family(
+        "raydp_events_dropped_total", "counter",
+        "Timeline events evicted from the bounded RAYDP_TPU_EVENT_BUFFER "
+        "ring before anything read them (same operability treatment as "
+        "raydp_spans_dropped_total).",
+    )
+    slo_status = _Family(
+        "raydp_slo_status", "gauge",
+        "SLO objective state: 1 while breached, 0 while meeting the "
+        "objective (doc/telemetry.md, SLO engine).",
+    )
+    slo_burn = _Family(
+        "raydp_slo_burn_rate", "gauge",
+        "Short-window error-budget burn rate per objective (1.0 = "
+        "consuming exactly the RAYDP_TPU_SLO_BUDGET).",
+    )
+    slo_breaches = _Family(
+        "raydp_slo_breaches_total", "counter",
+        "Breach episodes opened per objective (each also emits an "
+        "slo/breach timeline event with auto-triage context).",
+    )
     serve_counter_routes = {
         "serve/requests": serve_requests,
         "serve/replies": serve_replies,
@@ -593,6 +614,17 @@ def render_prometheus(view: Dict[str, Any]) -> str:
                         # workload stat: dedicated family so alerts can
                         # target it without label matching.
                         dropped.add({"worker": worker_id}, section[name])
+                        continue
+                    if name == "events/dropped":
+                        events_dropped.add({"worker": worker_id},
+                                           section[name])
+                        continue
+                    if name.startswith("slo/breaches/"):
+                        slo_breaches.add(
+                            {"worker": worker_id,
+                             "objective": name[len("slo/breaches/"):]},
+                            section[name],
+                        )
                         continue
                     if name == "watchdog/stalls":
                         # Same operability treatment as span loss: a
@@ -816,6 +848,18 @@ def render_prometheus(view: Dict[str, Any]) -> str:
                         serve_replicas_alive.add({"worker": worker_id}, value)
                     elif name == "mfu":
                         mfu.add({"worker": worker_id}, value)
+                    elif name.startswith("slo/status/"):
+                        slo_status.add(
+                            {"worker": worker_id,
+                             "objective": name[len("slo/status/"):]},
+                            value,
+                        )
+                    elif name.startswith("slo/burn/"):
+                        slo_burn.add(
+                            {"worker": worker_id,
+                             "objective": name[len("slo/burn/"):]},
+                            value,
+                        )
                     else:
                         gauges.add(
                             {"worker": worker_id, "name": name}, value
@@ -902,6 +946,7 @@ def render_prometheus(view: Dict[str, Any]) -> str:
                    serve_queue_depth, serve_batch_fill,
                    serve_replicas_alive, serve_rps, serve_latency,
                    serve_replica_latency,
+                   events_dropped, slo_status, slo_burn, slo_breaches,
                    host_rss,
                    hbm_bytes, store_occupancy, mfu, anomalies, step_hist,
                    generic_hist, gauges):
@@ -973,6 +1018,15 @@ def _default_events(job: Optional[str] = None) -> Dict[str, Any]:
     return {"events": records, "mttr": _events.mttr_report(records)}
 
 
+def _default_dashboard() -> Dict[str, Any]:
+    """``/debug/dashboard`` over this process's registry; driver
+    endpoints override with ``Cluster.dashboard_report`` (imported
+    lazily — dashboard pulls in the event/accounting stack)."""
+    from raydp_tpu.telemetry import dashboard as _dash
+
+    return _dash.local_dashboard()
+
+
 # /debug/profile capture windows: clamped so a fat-fingered
 # ?seconds=86400 can't pin a handler thread (and a jax trace buffer)
 # for a day.
@@ -1002,6 +1056,7 @@ def serve_prometheus(
     progress: Optional[Callable[[], Dict[str, Any]]] = None,
     profile: Optional[Callable[[float], Dict[str, Any]]] = None,
     events: Optional[Callable[[Optional[str]], Dict[str, Any]]] = None,
+    dashboard: Optional[Callable[[], Dict[str, Any]]] = None,
 ) -> _ScrapeServer:
     """Serve the process debug surface on a daemon thread.
 
@@ -1023,7 +1078,10 @@ def serve_prometheus(
     for the capture window, other routes stay responsive), and
     ``/debug/events?job=ID`` (the cluster event timeline + MTTR report
     from ``events()`` — default: every events shard under the
-    telemetry dir, else the local ring).
+    telemetry dir, else the local ring), and ``/debug/dashboard`` (the
+    unified flywheel dashboard JSON from ``dashboard()`` — default the
+    local-registry view; the driver passes
+    ``Cluster.dashboard_report``).
     Stdlib ``http.server`` only: one scrape every few seconds, no need
     for more. ``port=0`` binds an ephemeral port. Returns a handle with
     ``.port`` and idempotent ``.close()``."""
@@ -1033,6 +1091,7 @@ def serve_prometheus(
     progress_fn = progress if progress is not None else _default_progress
     profile_fn = profile if profile is not None else _default_profile
     events_fn = events if events is not None else _default_events
+    dashboard_fn = dashboard if dashboard is not None else _default_dashboard
 
     class Handler(BaseHTTPRequestHandler):
         def _reply(self, code: int, body: bytes, ctype: str) -> None:
@@ -1097,6 +1156,14 @@ def serve_prometheus(
                         ).encode("utf-8"),
                         "application/json",
                     )
+                elif path == "/debug/dashboard":
+                    self._reply(
+                        200,
+                        json.dumps(
+                            dashboard_fn(), default=str
+                        ).encode("utf-8"),
+                        "application/json",
+                    )
                 elif path == "/debug/profile":
                     try:
                         seconds = float(query.get("seconds", ["3"])[0])
@@ -1145,7 +1212,7 @@ def serve_prometheus(
     logger.info(
         "telemetry debug endpoint on %s:%d "
         "(/metrics /livez /healthz /debug/state /debug/stacks "
-        "/debug/progress /debug/profile /debug/events)",
+        "/debug/progress /debug/profile /debug/events /debug/dashboard)",
         host, server.port,
     )
     return server

@@ -24,10 +24,13 @@ Two on-disk shapes are accepted transparently:
   where ``parsed`` (when non-null) holds the real shape. A wrapper
   whose ``parsed`` is null has nothing comparable → exit 2.
 
-Higher is better for every matched metric (rates and MFU), so a
-regression is ``new < old × (1 - threshold)``. Metrics present in only
-one file are reported but never fail the comparison — benchmarks come
-and go across revisions.
+Higher is better for rate/efficiency metrics (``*per_sec*``, ``mfu``,
+``batch_fill``), so a regression is ``new < old × (1 - threshold)``.
+Repair/startup latencies (``*mttr_s``, ``time_to_*`` — the
+``VERIFY_METRICS.json`` stamps the verify.sh smoke gates write) are
+lower-is-better: there the regression is the value growing. Metrics
+present in only one file are reported but never fail the comparison —
+benchmarks come and go across revisions.
 """
 from __future__ import annotations
 
@@ -38,7 +41,13 @@ from typing import Any, Dict, Optional, Tuple
 
 # Substrings of leaf keys that denote a higher-is-better metric.
 _RATE_MARKERS = ("per_sec",)
-_EXACT_KEYS = ("mfu",)
+_EXACT_KEYS = ("mfu", "batch_fill")
+
+# Substrings that denote a lower-is-better metric (repair/startup
+# latencies from the VERIFY_METRICS.json smoke stamps: preempt MTTR,
+# SLO MTTR, autoscaler time-to-grow). A regression is the metric
+# getting BIGGER.
+_INVERSE_MARKERS = ("mttr_s", "time_to_", "detect_s", "drain_s")
 
 # Sections of an entry that hold nested telemetry, not results — their
 # numeric leaves (e.g. meter/rows_per_sec gauges) are point-in-time
@@ -69,11 +78,19 @@ def _collect(
             elif isinstance(value, (int, float)) and not isinstance(
                 value, bool
             ):
-                lk = str(key).lower()
-                if lk in _EXACT_KEYS or any(
-                    m in lk for m in _RATE_MARKERS
-                ):
+                if _direction(str(key)) is not None:
                     out[path] = float(value)
+
+
+def _direction(key: str) -> Optional[int]:
+    """+1 higher-is-better, -1 lower-is-better, None not comparable."""
+    lk = key.lower()
+    leaf = lk.rsplit(".", 1)[-1]
+    if leaf in _EXACT_KEYS or any(m in lk for m in _RATE_MARKERS):
+        return 1
+    if any(m in lk for m in _INVERSE_MARKERS):
+        return -1
+    return None
 
 
 def extract_metrics(doc: Any) -> Dict[str, float]:
@@ -107,6 +124,11 @@ def compare(
         if o <= 0:
             continue
         ratio = n / o
+        if _direction(key) == -1:
+            # Lower is better: a bigger value is the regression, and
+            # "ratio" is inverted so the printout's slower/faster
+            # wording stays truthful.
+            ratio = o / n if n > 0 else float("inf")
         if ratio < 1.0 - threshold:
             regressions.append((key, o, n, ratio))
         elif ratio > 1.0 + threshold:

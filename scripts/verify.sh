@@ -18,6 +18,23 @@ export RAYDP_TPU_POSTMORTEM_DIR="${RAYDP_TPU_POSTMORTEM_DIR:-/tmp/raydp_tpu_post
 # appends its StageStats record here (stats-<pid>.jsonl shards),
 # dumped below on failure so CI shows what the engine was doing.
 export RAYDP_TPU_STATS_DIR="${RAYDP_TPU_STATS_DIR:-/tmp/raydp_tpu_stats.$$}"
+# Machine-readable smoke-gate metrics (preempt MTTR, serve fill,
+# time-to-grow, SLO breach-detect/MTTR): each gate below stamps its
+# numbers here via scripts/verify_metrics.py; the advisory step at the
+# bottom diffs them against the previous run's stamp with the same
+# bench_compare rules that gate the BENCH leaves.
+export VERIFY_METRICS_PATH="${VERIFY_METRICS_PATH:-$PWD/VERIFY_METRICS.json}"
+if [ -f "$VERIFY_METRICS_PATH" ]; then
+  mv -f "$VERIFY_METRICS_PATH" "${VERIFY_METRICS_PATH%.json}.prev.json"
+fi
+# On any gate failure, ship the unified dashboard with the black box:
+# the same document /debug/dashboard serves, rebuilt offline from the
+# gate's telemetry dir (or the local registry when the gate kept none).
+dump_dashboard() {
+  echo "--- dashboard dump (postmortem) ---"
+  JAX_PLATFORMS=cpu python -m raydp_tpu.telemetry.dashboard --json "$@" \
+    || echo "(dashboard unavailable)"
+}
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
   -m 'not slow' --continue-on-collection-errors \
   -p no:cacheprovider -p no:xdist -p no:randomly "$@" 2>&1 | tee "$LOG"
@@ -54,6 +71,7 @@ if [ "$rc" -eq 0 ]; then
     echo "RAYDPCHECK=failed"
     echo "--- raydpcheck JSON report ---"
     cat "$check_json" 2>/dev/null || echo "(no report written)"
+    dump_dashboard
     rc=1
   fi
   rm -f "$check_json"
@@ -65,7 +83,8 @@ if [ "$rc" -eq 0 ]; then
   smoke_dir=$(mktemp -d)
   JAX_PLATFORMS=cpu RAYDP_TPU_STATS_DIR="$smoke_dir" python - <<'PYEOF' \
     && JAX_PLATFORMS=cpu python -m raydp_tpu.telemetry.analyze "$smoke_dir" >/dev/null \
-    && echo "ANALYZE_SMOKE=ok" || { echo "ANALYZE_SMOKE=failed"; rc=1; }
+    && echo "ANALYZE_SMOKE=ok" \
+    || { echo "ANALYZE_SMOKE=failed"; dump_dashboard; rc=1; }
 import numpy as np, pandas as pd
 import raydp_tpu.dataframe as rdf
 from raydp_tpu.dataframe import dataframe as D
@@ -90,7 +109,8 @@ fi
 if [ "$rc" -eq 0 ]; then
   echo "--- chaos smoke (injected rank kill) ---"
   JAX_PLATFORMS=cpu python - <<'PYEOF' \
-    && echo "CHAOS_SMOKE=ok" || { echo "CHAOS_SMOKE=failed"; rc=1; }
+    && echo "CHAOS_SMOKE=ok" \
+    || { echo "CHAOS_SMOKE=failed"; dump_dashboard; rc=1; }
 import os
 import tempfile
 
@@ -161,8 +181,10 @@ if [ "$rc" -eq 0 ]; then
   JAX_PLATFORMS=cpu RAYDP_TPU_TELEMETRY_DIR="$acct_dir" python - <<'PYEOF' \
     && JAX_PLATFORMS=cpu python -m raydp_tpu.telemetry.events "$acct_dir" \
          | grep -q "== job" \
-    && echo "ACCOUNTING_SMOKE=ok" || { echo "ACCOUNTING_SMOKE=failed"; rc=1; }
+    && echo "ACCOUNTING_SMOKE=ok" \
+    || { echo "ACCOUNTING_SMOKE=failed"; dump_dashboard "$acct_dir"; rc=1; }
 import threading
+import time
 
 import numpy as np
 import pandas as pd
@@ -171,6 +193,8 @@ import raydp_tpu.dataframe as rdf
 from raydp_tpu import telemetry
 from raydp_tpu.dataframe import dataframe as D
 from raydp_tpu.utils.profiling import metrics
+
+_t0 = time.monotonic()
 
 # Force real exchanges: coalesced groupBys move no bytes to attribute.
 D._EXCHANGE_COALESCE_BYTES = 0
@@ -222,6 +246,13 @@ for kind in ("shuffle_bytes", "chip_seconds"):
     )
     assert abs(total - per_job) <= 1e-6 * max(1.0, total), \
         (kind, total, per_job)
+
+elapsed = time.monotonic() - _t0
+exec(open("scripts/verify_metrics.py").read())
+stamp("accounting_smoke", {
+    "shuffle_bytes_per_sec": report["totals"]["shuffle_bytes"] / elapsed,
+    "chip_seconds": report["totals"]["chip_seconds"],
+})
 PYEOF
   rm -rf "$acct_dir"
 fi
@@ -239,7 +270,8 @@ if [ "$rc" -eq 0 ]; then
   JAX_PLATFORMS=cpu RAYDP_TPU_TELEMETRY_DIR="$sched_dir" python - <<'PYEOF' \
     && JAX_PLATFORMS=cpu python -m raydp_tpu.telemetry.events "$sched_dir" \
          | grep -q "sched/preempt -> sched/resume" \
-    && echo "SCHED_SMOKE=ok" || { echo "SCHED_SMOKE=failed"; rc=1; }
+    && echo "SCHED_SMOKE=ok" \
+    || { echo "SCHED_SMOKE=failed"; dump_dashboard "$sched_dir"; rc=1; }
 import glob
 import os
 import tempfile
@@ -319,11 +351,13 @@ while time.monotonic() < deadline and not os.path.isfile(mid):
     time.sleep(0.05)
 assert os.path.isfile(mid), "victim never reached its first mid ckpt"
 
+_t_arr = time.monotonic()
 with telemetry.job_scope(telemetry.mint_job("arrival", priority=5)):
     arrival = fit_spmd(
         factory_builder(None, 1), arrival_ds, world_size=1,
         env={"JAX_PLATFORMS": "cpu"}, timeout=300,
     )
+arrival_elapsed = time.monotonic() - _t_arr
 vt.join(300.0)
 victim = victim_out["res"]
 
@@ -335,6 +369,24 @@ np.testing.assert_allclose(
     victim["history"][-1]["train_loss"],
     clean["history"][-1]["train_loss"], rtol=1e-4,
 )
+
+# Stamp the preempt->resume MTTR the timeline CLI renders below (the
+# episode lives in the training subprocess's event shards).
+from raydp_tpu.telemetry import events as events_mod
+
+records = events_mod.load_event_records(os.environ["RAYDP_TPU_TELEMETRY_DIR"])
+mttrs = [
+    ep["repair_s"]
+    for job in events_mod.mttr_report(records).values()
+    for ep in job.get("episodes", [])
+    if ep.get("start_kind") == "sched/preempt"
+    and ep.get("end_kind") == "sched/resume"
+]
+exec(open("scripts/verify_metrics.py").read())
+stamp("sched_smoke", {
+    "preempt_mttr_s": max(mttrs) if mttrs else -1.0,
+    "arrival_epochs_per_sec": len(arrival["history"]) / arrival_elapsed,
+})
 PYEOF
   rm -rf "$sched_dir"
 fi
@@ -347,7 +399,8 @@ if [ "$rc" -eq 0 ]; then
   echo "--- serving smoke (replica kill under traffic) ---"
   JAX_PLATFORMS=cpu RAYDP_TPU_FAULT_PLAN="serve_kill:replica=0,request=5" \
     python - <<'PYEOF' \
-    && echo "SERVE_SMOKE=ok" || { echo "SERVE_SMOKE=failed"; rc=1; }
+    && echo "SERVE_SMOKE=ok" \
+    || { echo "SERVE_SMOKE=failed"; dump_dashboard; rc=1; }
 import threading
 import time
 
@@ -367,6 +420,7 @@ def make_model():
 N, PER = 240, 30
 results = [None] * N
 errors = []
+_t0 = time.monotonic()
 
 with ReplicaGroup(
     replicas=2, model_fn=make_model(), label="smoke-serve",
@@ -409,6 +463,13 @@ assert stats["replies"] == N and stats["errors"] == 0, stats
 snap = metrics.snapshot()["counters"]
 fill = snap["serve/batch_requests"] / (snap["serve/batches"] * 4)
 assert fill > 0.5, (fill, snap)
+
+exec(open("scripts/verify_metrics.py").read())
+stamp("serve_smoke", {
+    "replies_per_sec": N / (time.monotonic() - _t0),
+    "batch_fill": fill,
+    "restarts": stats["restarts"],
+})
 PYEOF
 fi
 # Autoscale smoke (HARD): sustained admission pressure grows a real
@@ -427,7 +488,8 @@ if [ "$rc" -eq 0 ]; then
     && as_tl=$(JAX_PLATFORMS=cpu python -m raydp_tpu.telemetry.events "$as_dir") \
     && grep -q "autoscale/decision" <<<"$as_tl" \
     && grep -q "autoscale/spawn_failed" <<<"$as_tl" \
-    && echo "AUTOSCALE_SMOKE=ok" || { echo "AUTOSCALE_SMOKE=failed"; rc=1; }
+    && echo "AUTOSCALE_SMOKE=ok" \
+    || { echo "AUTOSCALE_SMOKE=failed"; dump_dashboard "$as_dir"; rc=1; }
 import threading
 import time
 
@@ -470,14 +532,18 @@ while time.monotonic() < deadline and arb.report()["queue_depth"] != 1:
     time.sleep(0.02)
 assert arb.report()["queue_depth"] == 1, arb.report()
 
+_t_grow = time.monotonic()
 d = sc.step()  # one evaluation under pressure must already grow
+time_to_grow = time.monotonic() - _t_grow
 assert d.verdict == "grow", d
 assert len(cluster.alive_workers()) == 2
 
 # -- phase 2: second grow trips spawn_fail:nth=1 -> backoff, retry,
 # converge (chaos-hardened provisioning).
 time.sleep(0.35)  # clear the up-cooldown
+_t_grow = time.monotonic()
 d = sc.step()
+time_to_grow_retry = time.monotonic() - _t_grow
 assert d.verdict == "grow", d
 assert len(cluster.alive_workers()) == 3
 snap = metrics.snapshot()["counters"]
@@ -505,15 +571,19 @@ def etl():
         )
 
 
+_t_etl = time.monotonic()
 et = threading.Thread(target=etl, daemon=True)
 et.start()
 time.sleep(0.3)  # tasks in flight on all three workers
+_t_drain = time.monotonic()
 deadline = time.monotonic() + 60.0
 while time.monotonic() < deadline and len(cluster.alive_workers()) > 1:
     sc.step()
     time.sleep(0.25)
+drain_s = time.monotonic() - _t_drain
 assert len(cluster.alive_workers()) == 1, cluster.alive_workers()
 et.join(180.0)
+etl_elapsed = time.monotonic() - _t_etl
 assert etl_out["res"] == items, "tasks lost in scale-down"
 
 # -- phase 4: zero flap episodes — all grows strictly precede all
@@ -530,8 +600,124 @@ assert len(decided) == len(
 ), (len(decided), [d.verdict for d in sc.decisions])
 
 raydp_tpu.stop()
+
+exec(open("scripts/verify_metrics.py").read())
+stamp("autoscale_smoke", {
+    "time_to_grow_s": time_to_grow,
+    "time_to_grow_retry_s": time_to_grow_retry,
+    "drain_s": drain_s,
+    "etl_tasks_per_sec": len(items) / etl_elapsed,
+})
 PYEOF
   rm -rf "$as_dir"
+fi
+# Observability smoke (HARD): an injected serve latency fault must
+# drive the full SLO loop live — the time-series sampler sees the p99
+# spike, the engine opens a breach within one evaluation window with
+# the offending series and correlated timeline events attached,
+# traffic dilution recovers it with a measured MTTR, the episode is a
+# first-class MTTR entry, the raydp_slo_* families render it, and the
+# dashboard CLI reconstructs it offline from the gate's event shards —
+# the end-to-end proof of doc/telemetry.md's SLO engine story.
+if [ "$rc" -eq 0 ]; then
+  echo "--- observability smoke (SLO breach -> triage -> recovery) ---"
+  obs_dir=$(mktemp -d)
+  JAX_PLATFORMS=cpu RAYDP_TPU_TELEMETRY_DIR="$obs_dir" \
+    RAYDP_TPU_FAULT_PLAN="latency:nth=0,delay=0.8,replica=0" \
+    python - <<'PYEOF' \
+    && obs_cli=$(JAX_PLATFORMS=cpu python -m raydp_tpu.telemetry.dashboard "$obs_dir") \
+    && grep -q "slo/breach" <<<"$obs_cli" \
+    && grep -q "slo/recovered" <<<"$obs_cli" \
+    && echo "OBS_SMOKE=ok" \
+    || { echo "OBS_SMOKE=failed"; dump_dashboard "$obs_dir"; rc=1; }
+import time
+
+from raydp_tpu.serve import ReplicaGroup
+from raydp_tpu.telemetry import events as events_mod
+from raydp_tpu.telemetry import render_prometheus
+from raydp_tpu.telemetry.slo import SloConfig, SloEngine, default_objectives
+from raydp_tpu.telemetry.timeseries import TimeSeriesConfig, TimeSeriesSampler
+from raydp_tpu.utils.profiling import metrics
+
+
+def make_model():
+    # Nested so cloudpickle ships it by value to the replica procs.
+    def model(payloads, bucket):
+        return [float(sum(p)) for p in payloads]
+
+    return model
+
+
+sampler = TimeSeriesSampler(config=TimeSeriesConfig(
+    interval_s=0.05, capacity=512, max_series=512,
+))
+engine = SloEngine(
+    store=sampler.store,
+    config=SloConfig(
+        interval_s=0.05, short_window_s=1.0, long_window_s=6.0,
+        budget=0.2, burn_threshold=1.0, recovery_evals=2,
+    ),
+    objectives=[o for o in default_objectives() if o.name == "serve_p99"],
+)
+_t0 = time.monotonic()
+with ReplicaGroup(
+    replicas=1, model_fn=make_model(), label="obs-smoke",
+    max_batch=1, slo_ms=10_000, restart_backoff_s=0.1,
+).start() as group:
+    group.predict([1, 2, 3])  # the armed clause stalls this 0.8 s
+    t_fault = time.monotonic()
+    breach = None
+    deadline = time.monotonic() + 20.0
+    while time.monotonic() < deadline and breach is None:
+        sampler.sample()
+        for tr in engine.evaluate():
+            if tr["kind"] == "breach":
+                breach = tr
+        time.sleep(0.05)
+    assert breach is not None, "no breach within the evaluation window"
+    breach_detect_s = time.monotonic() - t_fault
+    attrs = breach["event"]["attrs"]
+    assert any(
+        r["series"] == "serve/latency/p99_s" for r in attrs["top_series"]
+    ), attrs
+    assert isinstance(attrs["correlated"], list), attrs
+
+    for i in range(150):  # dilute the rolling p99 below the spike
+        group.predict([i, i])
+    recovered = None
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline and recovered is None:
+        sampler.sample()
+        for tr in engine.evaluate():
+            if tr["kind"] == "recovered":
+                recovered = tr
+        time.sleep(0.05)
+    assert recovered is not None, "no recovery within deadline"
+    assert recovered["mttr_s"] > 0
+
+report = events_mod.mttr_report(events_mod.local_events())
+assert any(
+    ep.get("start_kind") == "slo/breach"
+    and ep.get("end_kind") == "slo/recovered"
+    for job in report.values() for ep in job.get("episodes", [])
+), report
+text = render_prometheus(
+    {"workers": {}, "aggregate": {}, "driver": metrics.snapshot()}
+)
+for family in ("raydp_slo_breaches_total", "raydp_slo_status",
+               "raydp_slo_burn_rate"):
+    assert family in text, family
+stats = sampler.store.stats()
+assert stats["memory_bytes_est"] < 32 * 1024 * 1024, stats
+
+exec(open("scripts/verify_metrics.py").read())
+stamp("obs_smoke", {
+    "breach_detect_s": breach_detect_s,
+    "slo_mttr_s": recovered["mttr_s"],
+    "samples_per_sec": stats["samples"] / (time.monotonic() - _t0),
+})
+PYEOF
+  rm -rf "$obs_dir"
 fi
 # Bench regression gate (ADVISORY): when two result files exist, diff
 # the newest pair; a >10% throughput/MFU regression prints loudly but
@@ -542,6 +728,14 @@ if [ "$rc" -eq 0 ]; then
   if [ "${#bench_files[@]}" -eq 2 ]; then
     echo "--- bench regression check (advisory) ---"
     python scripts/bench_compare.py "${bench_files[1]}" "${bench_files[0]}" || true
+  fi
+  # Smoke-gate metrics drift (ADVISORY): same rules, over the
+  # VERIFY_METRICS.json the gates above just stamped vs the previous
+  # run's stamp (preempt MTTR, serve fill, time-to-grow, SLO MTTR).
+  prev_metrics="${VERIFY_METRICS_PATH%.json}.prev.json"
+  if [ -f "$prev_metrics" ] && [ -f "$VERIFY_METRICS_PATH" ]; then
+    echo "--- smoke-metrics drift check (advisory) ---"
+    python scripts/bench_compare.py "$prev_metrics" "$VERIFY_METRICS_PATH" || true
   fi
 fi
 exit $rc
