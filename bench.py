@@ -2337,6 +2337,84 @@ def bench_serving():
     }
 
 
+def bench_serve_load():
+    """Load-observatory evidence (doc/serving.md#load-observatory): an
+    open-loop knee ramp against a live two-replica group, reporting
+    the max sustainable RPS under the step SLO, plus one probe step at
+    80% of the knee for an honest below-knee p99 and the per-phase
+    time split. The group's linger window is kept tiny (slo_ms=5) so
+    the knee measures execute capacity, not the batching linger floor,
+    and ``max_batch=1`` with a ~12 ms model pins that capacity low
+    enough (~2/0.012 ≈ 170 rps) that the cliff lands inside the ramp —
+    a saturated knee, not a ramp-ceiling artifact."""
+    from raydp_tpu import control
+    from raydp_tpu.loadgen import (
+        GroupTarget, KneeConfig, find_knee, poisson_schedule,
+        run_schedule,
+    )
+    from raydp_tpu.serve import ReplicaGroup
+    from raydp_tpu.utils.profiling import metrics as _metrics
+
+    control.reset_for_tests()
+    _metrics.reset()
+
+    def make_model():
+        # Nested so cloudpickle ships it by value to the replica procs.
+        def model(payloads, bucket):
+            time.sleep(0.012)
+            return [float(sum(p)) for p in payloads]
+
+        return model
+
+    config = KneeConfig(
+        start_rps=8.0, max_rps=512.0, step_factor=2.0,
+        step_duration_s=1.5, slo_ms=150.0, shed_threshold=0.05,
+        bisect_rounds=2, timeout_s=5.0, seed=0,
+    )
+    with ReplicaGroup(
+        replicas=2, model_fn=make_model(), label="bench-serve-load",
+        slo_ms=5, max_batch=1, max_queue=512, restart_backoff_s=0.2,
+    ).start() as group:
+        boot_deadline = time.monotonic() + 30.0
+        while group.stats()["replicas_alive"] < 2:
+            if time.monotonic() >= boot_deadline:
+                raise RuntimeError(
+                    "serve_load bench: replicas never came up"
+                )
+            time.sleep(0.02)
+        group.predict([0] * 8, timeout_s=30.0)  # warm dispatch path
+        target = GroupTarget(group)
+        result = find_knee(target, config)
+        probe_rps = max(1.0, 0.8 * result.knee_rps)
+        probe = run_schedule(
+            target,
+            poisson_schedule(
+                probe_rps, config.step_duration_s,
+                seed=config.seed + 101,
+            ),
+            timeout_s=config.timeout_s,
+        )
+    p99 = probe.latency_quantile(0.99)
+    fractions = probe.phase_fractions()
+    return {
+        "knee_rps": round(result.knee_rps, 2),
+        "saturated": result.saturated,
+        "p99_at_knee_ms": (
+            round(result.p99_at_knee_s * 1e3, 3)
+            if result.p99_at_knee_s is not None else None
+        ),
+        "shed_at_knee": round(result.shed_at_knee, 4),
+        "ramp_steps": len(result.curve),
+        "p99_at_80pct_knee_ms": (
+            round(p99 * 1e3, 3) if p99 is not None else None
+        ),
+        "probe_shed_rate": round(probe.rate("shed"), 4),
+        "phase_fractions": {
+            k: round(v, 4) for k, v in fractions.items()
+        },
+    }
+
+
 def bench_autoscale():
     """Autoscaler evidence (doc/scheduling.md#autoscaling): against a
     real one-worker cluster, sustained admission pressure must grow
@@ -2476,6 +2554,9 @@ CPU_MATRIX = [
     # Serving plane: continuous batching vs naive per-request dispatch
     # over real replica processes (doc/serving.md).
     ("serving", bench_serving),
+    # Load observatory: open-loop knee ramp over the same replica
+    # group — max sustainable RPS + phase split (doc/serving.md).
+    ("serve_load", bench_serve_load),
     # Self-sizing pool: time-to-scale-up, graceful-drain latency, and
     # flap count against a real worker pool (doc/scheduling.md).
     ("autoscale", bench_autoscale),

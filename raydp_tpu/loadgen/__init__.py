@@ -1,0 +1,73 @@
+"""Load observatory: trace-replay load generation, capacity-knee
+finding, and per-request latency provenance rendering.
+
+The serving plane's policies (continuous batching, shed/429, drain,
+autoscaling) had only ever been exercised by a few hundred smoke
+requests; this package is the measurement machinery that turns the
+ROADMAP's "millions of users" claim into numbers. Three layers:
+
+- :mod:`~raydp_tpu.loadgen.schedules` + :mod:`~raydp_tpu.loadgen.trace`
+  — arrival-schedule generators (Poisson, heavy-tail, diurnal, flash
+  crowd), a JSONL trace format, and a recorder that captures a live
+  :class:`~raydp_tpu.serve.batching.RequestQueue`'s real arrivals for
+  later replay.
+- :mod:`~raydp_tpu.loadgen.runner` — the open-loop runner: a timer
+  wheel fires requests at their scheduled offsets regardless of how
+  the backend is doing (late replies never throttle offered load),
+  recording per-request outcome, latency, and phase provenance.
+- :mod:`~raydp_tpu.loadgen.knee` — a stepped-ramp controller that
+  sweeps offered RPS until the SLO breaches for two consecutive
+  steps, then bisects to the capacity knee.
+
+``python -m raydp_tpu.loadgen report results.jsonl`` renders the knee
+curve and phase breakdown offline from a saved results file.
+"""
+from raydp_tpu.loadgen.knee import (
+    KneeConfig,
+    KneePoint,
+    KneeResult,
+    find_knee,
+    write_results,
+)
+from raydp_tpu.loadgen.runner import (
+    GroupTarget,
+    HttpTarget,
+    LoadResult,
+    QueueTarget,
+    RequestOutcome,
+    run_schedule,
+)
+from raydp_tpu.loadgen.schedules import (
+    TraceEvent,
+    diurnal_schedule,
+    flash_crowd_schedule,
+    heavy_tail_schedule,
+    poisson_schedule,
+)
+from raydp_tpu.loadgen.trace import (
+    TraceRecorder,
+    read_trace,
+    write_trace,
+)
+
+__all__ = [
+    "TraceEvent",
+    "poisson_schedule",
+    "heavy_tail_schedule",
+    "diurnal_schedule",
+    "flash_crowd_schedule",
+    "TraceRecorder",
+    "read_trace",
+    "write_trace",
+    "RequestOutcome",
+    "LoadResult",
+    "GroupTarget",
+    "QueueTarget",
+    "HttpTarget",
+    "run_schedule",
+    "KneeConfig",
+    "KneePoint",
+    "KneeResult",
+    "find_knee",
+    "write_results",
+]

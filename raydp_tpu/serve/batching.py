@@ -94,7 +94,8 @@ class ServeRequest:
     __slots__ = (
         "request_id", "payload", "length", "enqueued_mono",
         "deadline_mono", "attempts", "done", "result", "error",
-        "replied", "cancelled",
+        "replied", "cancelled", "dequeued_mono", "dispatched_mono",
+        "exec_s", "bucket", "phases",
     )
 
     def __init__(self, payload: Any, timeout_s: Optional[float] = None,
@@ -115,6 +116,13 @@ class ServeRequest:
         self.error: Optional[str] = None
         self.replied = False
         self.cancelled = False
+        # Provenance stamps (monotonic): set as the request moves
+        # queue → batch → replica; ``phases`` is filled at completion.
+        self.dequeued_mono: Optional[float] = None
+        self.dispatched_mono: Optional[float] = None
+        self.exec_s: Optional[float] = None
+        self.bucket: Optional[int] = None
+        self.phases: Optional[dict] = None
 
     def remaining_s(self, now: Optional[float] = None) -> float:
         return self.deadline_mono - (now if now is not None
@@ -140,6 +148,57 @@ class ServeRequest:
         if self.error is not None:
             raise RuntimeError(self.error)
         return self.result
+
+
+#: The additive phase decomposition: these four account for the whole
+#: accept→reply wall (queue_wait + linger + execute + reply == total).
+PHASE_NAMES = ("queue_wait", "linger", "execute", "reply")
+
+#: All phase histogram labels, including the informational
+#: ``padding_waste`` sub-slice of ``execute`` (not part of the sum).
+PHASE_LABELS = PHASE_NAMES + ("padding_waste",)
+
+
+def request_phases(req: ServeRequest,
+                   completed_mono: float) -> Optional[dict]:
+    """Decompose one request's life into phase durations (seconds).
+
+    ``queue_wait`` (admit → popped into a batch), ``linger`` (popped →
+    dispatched to a replica), ``execute`` (replica-measured model
+    wall, when the reply carried ``exec_s``; else the whole RPC wall),
+    ``reply`` (RPC + reply-delivery residual). The four sum to
+    ``total`` by construction. ``padding_waste`` is the slice of
+    ``execute`` spent on pad rows (``execute × (1 − length/bucket)``)
+    — informational, already counted inside ``execute``.
+
+    Returns ``None`` when the request never made it into a batch
+    (shed, expired in queue) — there is nothing to decompose.
+    """
+    if req.dequeued_mono is None:
+        return None
+    total = max(0.0, completed_mono - req.enqueued_mono)
+    queue_wait = max(0.0, req.dequeued_mono - req.enqueued_mono)
+    dispatched = (req.dispatched_mono if req.dispatched_mono is not None
+                  else req.dequeued_mono)
+    linger = max(0.0, dispatched - req.dequeued_mono)
+    tail = max(0.0, completed_mono - dispatched)
+    if req.exec_s is not None:
+        execute = min(max(0.0, req.exec_s), tail)
+    else:
+        execute = tail
+    reply = max(0.0, tail - execute)
+    waste = 0.0
+    if req.bucket and req.bucket > 0:
+        fill = min(1.0, max(0.0, req.length / req.bucket))
+        waste = execute * (1.0 - fill)
+    return {
+        "queue_wait": queue_wait,
+        "linger": linger,
+        "execute": execute,
+        "reply": reply,
+        "padding_waste": waste,
+        "total": total,
+    }
 
 
 class RequestQueue:
@@ -168,6 +227,9 @@ class RequestQueue:
         self._mu = threading.Condition(threading.Lock())
         self._pending: Deque[ServeRequest] = collections.deque()
         self._closed = False
+        # Arrival observers (loadgen trace recorder): called outside
+        # the lock after each successful admit with (req, mono_now).
+        self._arrival_observers: List[Any] = []
         # EWMA of per-request service time feeds the shed ETA; seeded
         # with the SLO so the very first 429 still carries a number.
         self._service_ewma_s = max(self.slo_s, 0.001)
@@ -218,6 +280,27 @@ class RequestQueue:
             metrics.counter_add("serve/requests")
             metrics.gauge_set("serve/queue_depth", len(self._pending))
             self._mu.notify()
+            observers = list(self._arrival_observers)
+        if observers:
+            now = time.monotonic()
+            for fn in observers:
+                try:
+                    fn(req, now)
+                except Exception:
+                    pass
+
+    def add_arrival_observer(self, fn: Any) -> None:
+        """Register ``fn(req, mono_now)`` to see every admitted
+        request — the loadgen trace recorder's capture point."""
+        with self._mu:
+            self._arrival_observers.append(fn)
+
+    def remove_arrival_observer(self, fn: Any) -> None:
+        with self._mu:
+            try:
+                self._arrival_observers.remove(fn)
+            except ValueError:
+                pass
 
     def requeue(self, reqs: Sequence[ServeRequest]) -> int:
         """Put in-flight requests back at the FRONT of the queue (a
@@ -240,6 +323,12 @@ class RequestQueue:
                     metrics.counter_add("serve/errors")
                     req.done.set()
                     continue
+                # Fresh provenance stamps for the retry attempt: the
+                # failed attempt's time lands in queue_wait, keeping
+                # the phase sum equal to the end-to-end wall.
+                req.dequeued_mono = None
+                req.dispatched_mono = None
+                req.exec_s = None
                 self._pending.appendleft(req)
                 n += 1
             if n:
@@ -299,6 +388,8 @@ class RequestQueue:
             if req.expired(now):
                 self._cancel_locked(req, "deadline expired in queue")
                 continue
+            req.dequeued_mono = now
+            req.bucket = self.bucket_for(req.length)
             return req
         return None
 
@@ -309,6 +400,8 @@ class RequestQueue:
                 continue  # swept by the next _pop_live_locked pass
             if self.bucket_for(req.length) == bucket:
                 del self._pending[i]
+                req.dequeued_mono = now
+                req.bucket = bucket
                 return req
         return None
 
@@ -335,14 +428,22 @@ class RequestQueue:
             req.replied = True
         req.result = result
         req.error = error
+        now = time.monotonic()
         if error is not None:
             metrics.counter_add("serve/errors")
         else:
             metrics.counter_add("serve/replies")
             metrics.meter("serve/throughput").add(1)
-        metrics.timer("serve/latency").observe(
-            time.monotonic() - req.enqueued_mono
-        )
+        # Cumulative histogram (not a rolling timer): bucket counts
+        # sum across replicas/workers, so the merged p99 is exact.
+        metrics.histogram("serve/latency").observe(now - req.enqueued_mono)
+        phases = request_phases(req, now)
+        if phases is not None:
+            req.phases = phases
+            for name in PHASE_LABELS:
+                metrics.histogram(f"serve/phase/{name}").observe(
+                    phases[name]
+                )
         req.done.set()
         return True
 

@@ -54,47 +54,93 @@ class ServeFrontend:
 
     # -- request handling (transport-independent, unit-testable) --------
 
-    def handle_predict(self, body: Dict[str, Any]) -> tuple:
+    def handle_predict(self, body: Dict[str, Any],
+                       headers: Optional[Dict[str, str]] = None) -> tuple:
         """Process one /predict body; returns ``(status, payload,
         headers)``. Import of ClusterBusyError is local so the frontend
-        stays importable without the control plane wired."""
+        stays importable without the control plane wired.
+
+        Correlation contract: every admitted request's response carries
+        ``X-RayDP-Request-Id`` and a ``traceparent`` header (an
+        incoming ``traceparent`` is honored, so a caller's trace id
+        threads through serve spans and events); 200 bodies carry the
+        per-phase latency decomposition.
+        """
+        import contextlib
+
         from raydp_tpu.control import ClusterBusyError
+        from raydp_tpu.telemetry import events as _events
+        from raydp_tpu.telemetry import propagation as _prop
 
         if "inputs" not in body:
             return 400, {"error": "body must carry 'inputs'"}, {}
-        t0 = time.monotonic()
-        try:
-            req = self.group.submit(
-                body["inputs"],
-                timeout_s=body.get("timeout_s"),
-                request_id=body.get("id"),
+        incoming = None
+        if headers:
+            lowered = {str(k).lower(): v for k, v in headers.items()}
+            incoming = _prop.from_traceparent(lowered.get("traceparent"))
+        scope = (_prop.propagated(incoming) if incoming is not None
+                 else contextlib.nullcontext())
+        with scope:
+            t0 = time.monotonic()
+            try:
+                req = self.group.submit(
+                    body["inputs"],
+                    timeout_s=body.get("timeout_s"),
+                    request_id=body.get("id"),
+                )
+            except (QueueFullError, ClusterBusyError) as exc:
+                shed_headers = {"Retry-After": str(retry_after_s(exc))}
+                if body.get("id"):
+                    shed_headers["X-RayDP-Request-Id"] = str(body["id"])
+                return (
+                    429,
+                    {
+                        "error": str(exc),
+                        "queue_depth": getattr(exc, "queue_depth", 0),
+                        "eta_s": getattr(exc, "eta_s", None),
+                    },
+                    shed_headers,
+                )
+            corr = {"X-RayDP-Request-Id": req.request_id}
+            traceparent = _prop.to_traceparent(
+                incoming if incoming is not None
+                else _prop.current_context()
             )
-        except (QueueFullError, ClusterBusyError) as exc:
+            if traceparent:
+                corr["traceparent"] = traceparent
+            try:
+                result = req.wait()
+            except RequestCancelled as exc:
+                _events.emit(
+                    "serve/timeout", request_id=req.request_id,
+                    attempts=req.attempts,
+                )
+                return (
+                    504,
+                    {"error": str(exc), "id": req.request_id},
+                    corr,
+                )
+            except Exception as exc:  # replica-side model failure
+                return (
+                    500,
+                    {"error": str(exc), "id": req.request_id},
+                    corr,
+                )
+            phases = req.phases
             return (
-                429,
+                200,
                 {
-                    "error": str(exc),
-                    "queue_depth": getattr(exc, "queue_depth", 0),
-                    "eta_s": getattr(exc, "eta_s", None),
+                    "id": req.request_id,
+                    "result": result,
+                    "latency_s": round(time.monotonic() - t0, 6),
+                    "attempts": req.attempts,
+                    "phases": (
+                        {k: round(v, 6) for k, v in phases.items()}
+                        if phases else None
+                    ),
                 },
-                {"Retry-After": str(retry_after_s(exc))},
+                corr,
             )
-        try:
-            result = req.wait()
-        except RequestCancelled as exc:
-            return 504, {"error": str(exc), "id": req.request_id}, {}
-        except Exception as exc:  # replica-side model failure
-            return 500, {"error": str(exc), "id": req.request_id}, {}
-        return (
-            200,
-            {
-                "id": req.request_id,
-                "result": result,
-                "latency_s": round(time.monotonic() - t0, 6),
-                "attempts": req.attempts,
-            },
-            {},
-        )
 
     # -- HTTP plumbing ---------------------------------------------------
 
@@ -148,7 +194,9 @@ class ServeFrontend:
                     self._reply_json(400, {"error": "invalid JSON body"})
                     return
                 try:
-                    code, payload, headers = frontend.handle_predict(body)
+                    code, payload, headers = frontend.handle_predict(
+                        body, headers=dict(self.headers.items())
+                    )
                     self._reply_json(code, payload, headers)
                 except Exception as exc:
                     try:

@@ -35,6 +35,7 @@ import cloudpickle
 
 from raydp_tpu.cluster.rpc import RpcClient, RpcServer
 from raydp_tpu.serve.batching import (
+    PHASE_LABELS,
     RequestQueue,
     ServeRequest,
     _env_float,
@@ -206,6 +207,8 @@ class _ReplicaSlot:
                 ),
             }
             t0 = time.monotonic()
+            for r in batch:
+                r.dispatched_mono = t0
             try:
                 reply = stub.call(
                     "ExecuteBatch", payload, timeout=g.dispatch_timeout_s
@@ -215,21 +218,34 @@ class _ReplicaSlot:
                 # go BACK to the queue head and retry on a surviving
                 # replica — the zero-dropped-request guarantee.
                 g.queue.requeue(batch)
+                _events.emit(
+                    "serve/requeue", group=g.label, replica=self.index,
+                    reason="dispatch_failed",
+                    request_ids=[r.request_id for r in batch],
+                )
                 return
             if reply.get("draining"):
                 # Drain refusal: replica got SIGTERM/preemption after
                 # assembly; hand the batch to a healthy lineage and
                 # wait out this incarnation.
                 g.queue.requeue(batch)
+                _events.emit(
+                    "serve/requeue", group=g.label, replica=self.index,
+                    reason="draining",
+                    request_ids=[r.request_id for r in batch],
+                )
                 self._await_exit()
                 return
             wall = time.monotonic() - t0
             g.queue.observe_service_time(wall / max(1, len(batch)))
-            metrics.timer(f"serve/replica/{self.index}/latency").observe(
-                wall
-            )
+            metrics.histogram(
+                f"serve/replica/{self.index}/latency"
+            ).observe(wall)
             results = reply.get("results") or []
+            exec_s = reply.get("exec_s")
             for req, result in zip(batch, results):
+                if isinstance(exec_s, (int, float)):
+                    req.exec_s = float(exec_s)
                 g.queue.complete(req, result=result)
             for req in batch[len(results):]:
                 g.queue.complete(
@@ -390,7 +406,11 @@ class ReplicaGroup:
     # -- introspection --------------------------------------------------
 
     def stats(self) -> Dict[str, Any]:
-        lat = metrics.timer("serve/latency").summary()
+        # Histogram-backed (PR 7 primitive): cumulative bucket counts
+        # merge exactly across replicas, and an empty histogram reads
+        # as None — a cold group reports nulls, never a fake 0 or a
+        # KeyError from an empty summary.
+        lat = metrics.histogram("serve/latency")
         thr = metrics.meter("serve/throughput").summary()
         snap = metrics.snapshot().get("counters", {})
         batches = snap.get("serve/batches", 0.0)
@@ -401,15 +421,29 @@ class ReplicaGroup:
         )
         per_replica = {}
         for slot in self._slots:
-            s = metrics.timer(
+            h = metrics.histogram(
                 f"serve/replica/{slot.index}/latency"
-            ).summary()
+            )
+            s = h.summary()
             per_replica[str(slot.index)] = {
                 "alive": slot.alive,
                 "restarts": slot.restarts,
-                "p50_s": s["p50_s"],
-                "p99_s": s["p99_s"],
+                "p50_s": h.quantile(0.5),
+                "p99_s": h.quantile(0.99),
                 "batches": s["count"],
+            }
+        phases = {}
+        for name in PHASE_LABELS:
+            ph = metrics.histogram(f"serve/phase/{name}")
+            s = ph.summary()
+            count = s["count"]
+            phases[name] = {
+                "count": count,
+                "total_s": round(float(s["sum"]), 6),
+                "mean_s": (
+                    round(float(s["sum"]) / count, 6) if count else None
+                ),
+                "p99_s": ph.quantile(0.99),
             }
         return {
             "group": self.label,
@@ -430,8 +464,9 @@ class ReplicaGroup:
             "restarts": snap.get("serve/restarts", 0.0),
             "batch_fill": round(fill, 4),
             "requests_per_sec": round(thr["per_sec"], 3),
-            "latency_p50_s": lat["p50_s"],
-            "latency_p99_s": lat["p99_s"],
+            "latency_p50_s": lat.quantile(0.5),
+            "latency_p99_s": lat.quantile(0.99),
+            "phases": phases,
             "per_replica": per_replica,
         }
 

@@ -118,6 +118,15 @@ def build(
         "queue_depth": g("serve/queue_depth"),
         "replicas_alive": g("serve/replicas_alive"),
         "throughput_per_sec": _rounded(g("serve/throughput/per_sec")),
+        # Latency provenance (mean ms per phase) and the most recent
+        # capacity knee, when a load sweep has run.
+        "phase_ms": {
+            name: _ms(g(f"serve/phase/{name}/mean_s"))
+            for name in ("queue_wait", "linger", "execute", "reply",
+                         "padding_waste")
+            if g(f"serve/phase/{name}/mean_s") is not None
+        },
+        "knee_rps": _rounded(g("loadgen/knee_rps")),
     }
     control = {
         "pool_size": g("autoscale/pool_size"),

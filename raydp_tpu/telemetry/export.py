@@ -551,12 +551,47 @@ def render_prometheus(view: Dict[str, Any]) -> str:
         "Reply throughput of the serving plane since start.",
     )
     serve_latency = _Family(
-        "raydp_serve_latency_seconds", "summary",
-        "End-to-end request latency (accept to reply) on the driver.",
+        "raydp_serve_latency_seconds", "histogram",
+        "End-to-end request latency (accept to reply) on the driver; "
+        "cumulative log-spaced buckets, so the merged cross-replica "
+        "p99 is exact (histogram_quantile on the _bucket ramp).",
     )
     serve_replica_latency = _Family(
-        "raydp_serve_replica_latency_seconds", "summary",
-        "Per-replica ExecuteBatch wall time, labelled by replica index.",
+        "raydp_serve_replica_latency_seconds", "histogram",
+        "Per-replica ExecuteBatch wall time, labelled by replica index "
+        "(cumulative histogram buckets).",
+    )
+    serve_phase = _Family(
+        "raydp_serve_phase_seconds", "histogram",
+        "Per-request latency provenance, labelled by phase: "
+        "queue_wait, linger, execute, reply (the four sum to the "
+        "end-to-end wall) plus padding_waste (the pad-row slice "
+        "inside execute).",
+    )
+    loadgen_fired = _Family(
+        "raydp_loadgen_fired_total", "counter",
+        "Requests fired by the open-loop load runner (offered load, "
+        "counted at the timer wheel — backend stalls never slow it).",
+    )
+    loadgen_requests = _Family(
+        "raydp_loadgen_requests_total", "counter",
+        "Load-runner terminal outcomes by status "
+        "(ok|shed|timeout|error|overload).",
+    )
+    loadgen_offered_rps = _Family(
+        "raydp_loadgen_offered_rps", "gauge",
+        "Offered request rate of the most recent load-runner schedule.",
+    )
+    loadgen_achieved_rps = _Family(
+        "raydp_loadgen_achieved_rps", "gauge",
+        "Achieved (status=ok) rate of the most recent load-runner "
+        "schedule.",
+    )
+    loadgen_knee_rps = _Family(
+        "raydp_loadgen_knee_rps", "gauge",
+        "Capacity knee from the most recent stepped-ramp sweep: the "
+        "highest offered RPS that held the SLO (load/knee event "
+        "carries the full verdict).",
     )
     events_dropped = _Family(
         "raydp_events_dropped_total", "counter",
@@ -805,6 +840,18 @@ def render_prometheus(view: Dict[str, Any]) -> str:
                             {"worker": worker_id}, section[name]
                         )
                         continue
+                    if name == "loadgen/fired":
+                        loadgen_fired.add(
+                            {"worker": worker_id}, section[name]
+                        )
+                        continue
+                    if name.startswith("loadgen/status/"):
+                        loadgen_requests.add(
+                            {"worker": worker_id,
+                             "status": name[len("loadgen/status/"):]},
+                            section[name],
+                        )
+                        continue
                     counters.add(
                         {"worker": worker_id, "name": name}, section[name]
                     )
@@ -846,6 +893,12 @@ def render_prometheus(view: Dict[str, Any]) -> str:
                         serve_batch_fill.add({"worker": worker_id}, value)
                     elif name == "serve/replicas_alive":
                         serve_replicas_alive.add({"worker": worker_id}, value)
+                    elif name == "loadgen/offered_rps":
+                        loadgen_offered_rps.add({"worker": worker_id}, value)
+                    elif name == "loadgen/achieved_rps":
+                        loadgen_achieved_rps.add({"worker": worker_id}, value)
+                    elif name == "loadgen/knee_rps":
+                        loadgen_knee_rps.add({"worker": worker_id}, value)
                     elif name == "mfu":
                         mfu.add({"worker": worker_id}, value)
                     elif name.startswith("slo/status/"):
@@ -877,19 +930,8 @@ def render_prometheus(view: Dict[str, Any]) -> str:
                     )
             elif key.startswith("timer/"):
                 tname = key[len("timer/"):]
-                if tname == "serve/latency":
-                    family = serve_latency
-                    labels = {"worker": worker_id}
-                elif tname.startswith("serve/replica/"):
-                    family = serve_replica_latency
-                    labels = {
-                        "worker": worker_id,
-                        "replica":
-                            tname[len("serve/replica/"):].split("/", 1)[0],
-                    }
-                else:
-                    family = timers
-                    labels = {"worker": worker_id, "name": tname}
+                family = timers
+                labels = {"worker": worker_id, "name": tname}
                 for q, stat in (("0.5", "p50_s"), ("0.9", "p90_s"),
                                 ("0.99", "p99_s")):
                     family.add(
@@ -901,6 +943,21 @@ def render_prometheus(view: Dict[str, Any]) -> str:
                 name = key[len("hist/"):]
                 if name == "train/step_seconds":
                     family, labels = step_hist, {"worker": worker_id}
+                elif name == "serve/latency":
+                    family, labels = serve_latency, {"worker": worker_id}
+                elif name.startswith("serve/replica/"):
+                    family = serve_replica_latency
+                    labels = {
+                        "worker": worker_id,
+                        "replica":
+                            name[len("serve/replica/"):].split("/", 1)[0],
+                    }
+                elif name.startswith("serve/phase/"):
+                    family = serve_phase
+                    labels = {
+                        "worker": worker_id,
+                        "phase": name[len("serve/phase/"):],
+                    }
                 else:
                     family = generic_hist
                     labels = {"worker": worker_id, "name": name}
@@ -945,7 +1002,9 @@ def render_prometheus(view: Dict[str, Any]) -> str:
                    serve_restarts, serve_batches, serve_batch_requests,
                    serve_queue_depth, serve_batch_fill,
                    serve_replicas_alive, serve_rps, serve_latency,
-                   serve_replica_latency,
+                   serve_replica_latency, serve_phase,
+                   loadgen_fired, loadgen_requests, loadgen_offered_rps,
+                   loadgen_achieved_rps, loadgen_knee_rps,
                    events_dropped, slo_status, slo_burn, slo_breaches,
                    host_rss,
                    hbm_bytes, store_occupancy, mfu, anomalies, step_hist,

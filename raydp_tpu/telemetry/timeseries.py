@@ -115,9 +115,13 @@ def flatten_view(view: Dict[str, Any]) -> Dict[str, float]:
 
     Folds the cross-worker ``aggregate`` and the ``driver`` registry
     into one namespace (counters/gauges/meter stats sum; timer
-    percentiles take the max — the straggler view). Histograms are
-    skipped: their windowed story is already told by the timers.
+    percentiles take the max — the straggler view). Histogram sections
+    flatten to the same ``<name>/p50_s``-style percentile series so
+    consumers (SLO engine, dashboard) are agnostic to whether a
+    latency is timer- or histogram-backed; empty histograms emit
+    nothing rather than a fabricated 0.
     """
+    from raydp_tpu.utils.profiling import quantile_from_hist_summary
     out: Dict[str, float] = {}
     for source_key in ("aggregate", "driver"):
         sections = view.get(source_key) or {}
@@ -140,6 +144,25 @@ def flatten_view(view: Dict[str, Any]) -> Dict[str, float]:
                         out[series] = max(out.get(series, 0.0), value)
                     else:
                         out[series] = out.get(series, 0.0) + value
+            elif key.startswith("hist/"):
+                hname = key[len("hist/"):]
+                try:
+                    count = float(section.get("count", 0.0))
+                except (AttributeError, TypeError, ValueError):
+                    continue
+                if count <= 0:
+                    continue
+                total = float(section.get("sum", 0.0))
+                for stat, q in (("p50_s", 0.5), ("p90_s", 0.9), ("p99_s", 0.99)):
+                    value = quantile_from_hist_summary(section, q)
+                    if value is None:
+                        continue
+                    series = f"{hname}/{stat}"
+                    out[series] = max(out.get(series, 0.0), value)
+                out[f"{hname}/mean_s"] = max(
+                    out.get(f"{hname}/mean_s", 0.0), total / count
+                )
+                out[f"{hname}/count"] = out.get(f"{hname}/count", 0.0) + count
             elif key.startswith("meter/"):
                 mname = key[len("meter/"):]
                 for stat in ("total", "per_sec"):

@@ -29,6 +29,7 @@ __all__ = [
     "StepTimer",
     "ThroughputMeter",
     "Histogram",
+    "quantile_from_hist_summary",
     "metrics",
     "trace",
     "annotate",
@@ -185,6 +186,49 @@ class Histogram:
             buckets[repr(bound)] = float(running)
         buckets["+Inf"] = float(n)
         return {"sum": total, "count": float(n), "buckets": buckets}
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Exact-to-one-bucket quantile (linear interpolation inside
+        the containing bucket); ``None`` when nothing was observed, so
+        cold-start readers see null instead of a fabricated 0."""
+        return quantile_from_hist_summary(self.summary(), q)
+
+
+def quantile_from_hist_summary(
+    summary: Dict[str, object], q: float
+) -> Optional[float]:
+    """Quantile from a :meth:`Histogram.summary` dict (also works on a
+    stat-wise *merged* summary, which is the point: cross-replica p99
+    is computed after bucket counts sum, not max-of-summaries).
+
+    Returns ``None`` on zero observations. Values landing in the +Inf
+    bucket report the largest finite bound (tail is censored there).
+    """
+    try:
+        count = float(summary.get("count", 0.0))  # type: ignore[union-attr]
+        buckets = summary.get("buckets") or {}
+    except AttributeError:
+        return None
+    if count <= 0 or not buckets:
+        return None
+    q = min(max(q, 0.0), 1.0)
+    rank = q * count
+    finite = sorted(
+        (float(le), float(c))
+        for le, c in buckets.items()
+        if le != "+Inf"
+    )
+    prev_bound, prev_cum = 0.0, 0.0
+    for bound, cum in finite:
+        if cum >= rank:
+            span = cum - prev_cum
+            if span <= 0:
+                return bound
+            frac = (rank - prev_cum) / span
+            return prev_bound + frac * (bound - prev_bound)
+        prev_bound, prev_cum = bound, cum
+    # rank falls in the +Inf bucket: report the largest finite bound.
+    return finite[-1][0] if finite else None
 
 
 @dataclass

@@ -719,6 +719,135 @@ stamp("obs_smoke", {
 PYEOF
   rm -rf "$obs_dir"
 fi
+# Load smoke (HARD): the load observatory measured against a live
+# replica group — a short open-loop ramp must find a FINITE capacity
+# knee (saturated, not a ramp-ceiling artifact), a probe step at 50%
+# of that knee must complete with zero non-shed errors, every
+# completed request's queue_wait+linger+execute+reply decomposition
+# must sum to its end-to-end wall within 5%, and the offline CLI must
+# reconstruct the knee curve from the raw results JSONL — the
+# end-to-end proof of doc/serving.md's load-observatory story.
+if [ "$rc" -eq 0 ]; then
+  echo "--- load smoke (knee ramp + phase provenance + offline report) ---"
+  load_dir=$(mktemp -d)
+  JAX_PLATFORMS=cpu RAYDP_TPU_LOADGEN_RESULTS="$load_dir/results.jsonl" \
+    python - <<'PYEOF' \
+    && load_cli=$(JAX_PLATFORMS=cpu python -m raydp_tpu.loadgen report "$load_dir/results.jsonl") \
+    && grep -q "knee: .* rps (saturated" <<<"$load_cli" \
+    && grep -q "phase breakdown" <<<"$load_cli" \
+    && echo "LOAD_SMOKE=ok" \
+    || { echo "LOAD_SMOKE=failed"; dump_dashboard; rc=1; }
+import os
+import time
+
+from raydp_tpu.loadgen import (
+    GroupTarget, KneeConfig, find_knee, poisson_schedule, run_schedule,
+    write_results,
+)
+from raydp_tpu.serve import ReplicaGroup
+
+
+def make_model():
+    # Nested so cloudpickle ships it by value to the replica procs.
+    def model(payloads, bucket):
+        time.sleep(0.012)
+        return [float(sum(p)) for p in payloads]
+
+    return model
+
+
+# max_batch=1 + ~12ms model pins capacity near 2/0.012 ~ 170 rps so
+# the cliff lands inside a short ramp; tiny linger keeps the knee
+# about execute capacity, not the batching window.
+config = KneeConfig(
+    start_rps=16.0, max_rps=1024.0, step_factor=2.0,
+    step_duration_s=1.0, slo_ms=150.0, shed_threshold=0.05,
+    bisect_rounds=2, timeout_s=5.0, seed=0,
+)
+with ReplicaGroup(
+    replicas=2, model_fn=make_model(), label="smoke-load",
+    max_batch=1, slo_ms=5, max_queue=512, restart_backoff_s=0.2,
+).start() as group:
+    deadline = time.monotonic() + 30.0
+    while group.stats()["replicas_alive"] < 2:
+        assert time.monotonic() < deadline, "replicas never came up"
+        time.sleep(0.02)
+    group.predict([0] * 8, timeout_s=30.0)  # warm dispatch path
+    target = GroupTarget(group)
+    result = find_knee(target, config)
+    # Probe step at 50% of the knee: comfortably below capacity, so
+    # nothing may shed, time out, or error.
+    probe = run_schedule(
+        target,
+        poisson_schedule(
+            max(1.0, 0.5 * result.knee_rps), 1.5, seed=101
+        ),
+        timeout_s=config.timeout_s,
+    )
+    probe80 = run_schedule(
+        target,
+        poisson_schedule(
+            max(1.0, 0.8 * result.knee_rps), 1.5, seed=202
+        ),
+        timeout_s=config.timeout_s,
+    )
+
+# Finite knee: the ramp confirmed a cliff rather than running off the
+# top of the sweep.
+assert result.saturated, result.summary()
+assert 0 < result.knee_rps < config.max_rps, result.summary()
+
+counts = probe.counts()
+assert counts["ok"] == len(probe.outcomes) and counts["ok"] > 0, counts
+
+# Latency provenance: the four additive phases reconstruct each
+# request's accept->reply wall exactly, and that wall accounts for
+# the client-observed end-to-end latency within 5% (plus 10ms
+# absolute slack — submit admission + waiter-thread wakeup live
+# outside the queue's window and jitter on a loaded CI box).
+decomposed = 0
+for out in probe.outcomes + probe80.outcomes:
+    if out.status != "ok" or not out.phases:
+        continue
+    decomposed += 1
+    phase_sum = sum(
+        out.phases[k]
+        for k in ("queue_wait", "linger", "execute", "reply")
+    )
+    assert abs(phase_sum - out.phases["total"]) <= 1e-6, out.phases
+    gap = out.latency_s - phase_sum
+    assert gap >= -0.001, (phase_sum, out.latency_s)
+    assert gap <= max(0.05 * out.latency_s, 0.010), (
+        phase_sum, out.latency_s, out.phases
+    )
+assert decomposed > 0, "no request carried a phase decomposition"
+
+fractions = probe.phase_fractions()
+additive = sum(
+    fractions.get(k, 0.0)
+    for k in ("queue_wait", "linger", "execute", "reply")
+)
+assert abs(additive - 1.0) <= 0.05, fractions
+
+write_results(os.environ["RAYDP_TPU_LOADGEN_RESULTS"], result)
+
+p99_80 = probe80.latency_quantile(0.99)
+exec(open("scripts/verify_metrics.py").read())
+stamp("load_smoke", {
+    "knee_rps": result.knee_rps,
+    "p99_at_knee_ms": (
+        result.p99_at_knee_s * 1e3
+        if result.p99_at_knee_s is not None else None
+    ),
+    "p99_at_80pct_knee_ms": (
+        p99_80 * 1e3 if p99_80 is not None else None
+    ),
+    "probe_ok": counts["ok"],
+    "phase_sum_checked": decomposed,
+})
+PYEOF
+  rm -rf "$load_dir"
+fi
 # Bench regression gate (ADVISORY): when two result files exist, diff
 # the newest pair; a >10% throughput/MFU regression prints loudly but
 # never fails the tier-1 gate (bench noise on shared CI boxes is real

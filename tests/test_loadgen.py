@@ -1,0 +1,377 @@
+"""Load-observatory tests: schedule statistics, trace round-trip,
+open-loop discipline, knee convergence, and latency provenance.
+
+The statistical layers run on generated schedules alone (no backend);
+the open-loop and knee layers use synthetic in-process targets with
+known behavior — a stalling backend to prove the wheel never closes
+the loop, and a simulated single-server queue with a known capacity
+cliff to prove the ramp/bisect converges near it.
+"""
+import json
+import math
+import threading
+import time
+
+import pytest
+
+from raydp_tpu.loadgen import (
+    GroupTarget,
+    KneeConfig,
+    TraceEvent,
+    TraceRecorder,
+    diurnal_schedule,
+    find_knee,
+    flash_crowd_schedule,
+    heavy_tail_schedule,
+    poisson_schedule,
+    read_trace,
+    run_schedule,
+    write_results,
+    write_trace,
+)
+from raydp_tpu.loadgen.__main__ import (
+    phase_breakdown,
+    reconstruct_curve,
+    render_report,
+)
+from raydp_tpu.serve.batching import (
+    RequestQueue,
+    ServeRequest,
+    request_phases,
+)
+from raydp_tpu.utils.profiling import (
+    Histogram,
+    metrics,
+    quantile_from_hist_summary,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_metrics():
+    metrics.reset()
+    yield
+    metrics.reset()
+
+
+# ---------------------------------------------------------------------
+# schedules: mean rate, tail shape, burst structure
+# ---------------------------------------------------------------------
+
+
+def _mean_rate(events, duration_s):
+    return len(events) / duration_s
+
+
+def test_poisson_schedule_mean_rate_within_5pct():
+    rps, duration = 200.0, 30.0
+    events = poisson_schedule(rps, duration, seed=7)
+    assert abs(_mean_rate(events, duration) - rps) / rps < 0.05
+    # offsets are sorted, in-range, with event sizes bucketed
+    ts = [e.t for e in events]
+    assert ts == sorted(ts)
+    assert all(0 <= t < duration for t in ts)
+    assert all(e.size <= e.bucket for e in events)
+
+
+@pytest.mark.parametrize("dist", ["pareto", "lognormal"])
+def test_heavy_tail_mean_rate_and_shape(dist):
+    rps, duration = 200.0, 30.0
+    events = heavy_tail_schedule(rps, duration, seed=11, dist=dist)
+    # heavy-tail mean converges slower than Poisson: 10% tolerance on
+    # rate, but the shape requirement is the point of the test
+    assert abs(_mean_rate(events, duration) - rps) / rps < 0.10
+    gaps = [b.t - a.t for a, b in zip(events, events[1:])]
+    mean = sum(gaps) / len(gaps)
+    var = sum((g - mean) ** 2 for g in gaps) / len(gaps)
+    cv2 = var / (mean * mean)
+    # Poisson inter-arrivals have CV^2 == 1; heavy tails are burstier
+    assert cv2 > 1.5, f"{dist} CV^2 {cv2:.2f} not heavy-tailed"
+
+
+def test_poisson_interarrival_cv2_near_one():
+    events = poisson_schedule(200.0, 30.0, seed=7)
+    gaps = [b.t - a.t for a, b in zip(events, events[1:])]
+    mean = sum(gaps) / len(gaps)
+    var = sum((g - mean) ** 2 for g in gaps) / len(gaps)
+    assert 0.7 < var / (mean * mean) < 1.3
+
+
+def test_diurnal_schedule_modulates_rate():
+    rps, duration = 300.0, 20.0
+    events = diurnal_schedule(rps, duration, seed=3, cycles=1.0,
+                              amplitude=0.8)
+    # peak quarter (sin max at duration/4) vs trough quarter (3/4)
+    peak = sum(1 for e in events
+               if duration * 0.125 <= e.t < duration * 0.375)
+    trough = sum(1 for e in events
+                 if duration * 0.625 <= e.t < duration * 0.875)
+    assert peak > 2 * trough
+    # whole cycles keep the mean near rps
+    assert abs(_mean_rate(events, duration) - rps) / rps < 0.10
+
+
+def test_flash_crowd_burst_window_is_hot():
+    rps, duration = 100.0, 20.0
+    events = flash_crowd_schedule(
+        rps, duration, seed=5, burst_mult=5.0,
+        burst_start_frac=0.4, burst_duration_frac=0.2,
+    )
+    burst = [e for e in events
+             if duration * 0.4 <= e.t < duration * 0.6]
+    base = [e for e in events if e.t < duration * 0.4]
+    burst_rate = len(burst) / (duration * 0.2)
+    base_rate = len(base) / (duration * 0.4)
+    assert burst_rate > 3.0 * base_rate
+
+
+def test_schedules_are_deterministic():
+    a = heavy_tail_schedule(50.0, 5.0, seed=42)
+    b = heavy_tail_schedule(50.0, 5.0, seed=42)
+    assert a == b
+    c = heavy_tail_schedule(50.0, 5.0, seed=43)
+    assert a != c
+
+
+# ---------------------------------------------------------------------
+# trace format: bit-identical round-trip, live-queue recording
+# ---------------------------------------------------------------------
+
+
+def test_trace_round_trip_bit_identical(tmp_path):
+    events = heavy_tail_schedule(120.0, 10.0, seed=9)
+    path = str(tmp_path / "trace.jsonl")
+    assert write_trace(path, events, meta={"source": "test"}) == len(events)
+    back = read_trace(path)
+    assert back == events  # float repr round-trips exactly
+    # and a second generation loop is byte-stable
+    path2 = str(tmp_path / "trace2.jsonl")
+    write_trace(path2, back, meta={"source": "test"})
+    assert (tmp_path / "trace.jsonl").read_bytes() == \
+        (tmp_path / "trace2.jsonl").read_bytes()
+
+
+def test_trace_recorder_captures_live_arrivals(tmp_path):
+    q = RequestQueue(max_depth=64, slo_ms=5, buckets=[4, 16])
+    rec = TraceRecorder(q).start()
+    for i in range(5):
+        q.submit(ServeRequest([1] * (i + 1)))
+        time.sleep(0.01)
+    events = rec.stop()
+    assert len(events) == 5
+    assert [e.size for e in events] == [1, 2, 3, 4, 5]
+    assert [e.bucket for e in events] == [4, 4, 4, 4, 16]
+    ts = [e.t for e in events]
+    assert ts == sorted(ts) and ts[-1] >= 0.03
+    # detached: further arrivals are not recorded
+    q.submit(ServeRequest([1]))
+    assert len(rec.events()) == 5
+    path = str(tmp_path / "live.jsonl")
+    rec.save(path)
+    assert read_trace(path) == events
+    q.close()
+
+
+# ---------------------------------------------------------------------
+# open-loop runner: offered rate survives a stalling backend
+# ---------------------------------------------------------------------
+
+
+class _StallTarget:
+    """Every request blocks 0.4s — a closed-loop driver would crawl."""
+
+    def __init__(self):
+        self.fired = 0
+        self._mu = threading.Lock()
+
+    def fire(self, event, timeout_s):
+        with self._mu:
+            self.fired += 1
+        time.sleep(0.4)
+        return {"status": "ok"}
+
+
+def test_open_loop_holds_offered_rate_under_slow_backend():
+    rps, duration = 60.0, 1.5
+    events = poisson_schedule(rps, duration, seed=13)
+    target = _StallTarget()
+    t0 = time.monotonic()
+    result = run_schedule(target, events, timeout_s=2.0)
+    wall = time.monotonic() - t0
+    # every arrival fired (none throttled by the 0.4s stalls)
+    assert target.fired == len(events)
+    assert len(result.outcomes) == len(events)
+    # firing stayed on schedule: each request left within 150ms of its
+    # scheduled offset even though service time was 0.4s
+    lag = [o.fired_t - o.scheduled_t for o in result.outcomes]
+    assert max(lag) < 0.15, f"wheel lagged {max(lag):.3f}s"
+    # the run ends ~one service time after the last arrival, not
+    # len(events) x 0.4s as a closed loop would
+    assert wall < duration + 2.0
+    assert result.counts()["ok"] == len(events)
+
+
+def test_overload_cap_never_blocks_the_wheel():
+    events = poisson_schedule(100.0, 1.0, seed=17)
+    result = run_schedule(
+        _StallTarget(), events, timeout_s=1.0, max_inflight=10
+    )
+    counts = result.counts()
+    assert counts["overload"] > 0  # cap enforced...
+    assert counts["overload"] + counts["ok"] == len(events)
+    lag = [o.fired_t - o.scheduled_t for o in result.outcomes]
+    assert max(lag) < 0.15  # ...and the wheel never waited on it
+
+
+# ---------------------------------------------------------------------
+# knee finder: converges on a synthetic capacity cliff
+# ---------------------------------------------------------------------
+
+
+class _CliffTarget:
+    """Simulated single server at ``capacity`` rps: a virtual queue
+    whose waiting time explodes once offered load crosses capacity."""
+
+    def __init__(self, capacity_rps):
+        self.capacity = capacity_rps
+        self._mu = threading.Lock()
+        self._next_free = 0.0
+
+    def fire(self, event, timeout_s):
+        now = time.monotonic()
+        with self._mu:
+            start = max(now, self._next_free)
+            self._next_free = start + 1.0 / self.capacity
+            done = self._next_free
+        latency = done - now
+        if latency > timeout_s:
+            time.sleep(timeout_s)
+            return {"status": "timeout"}
+        time.sleep(latency)
+        return {"status": "ok"}
+
+
+def test_knee_finder_converges_on_synthetic_cliff(tmp_path):
+    capacity = 80.0
+    cfg = KneeConfig(
+        start_rps=10.0, max_rps=640.0, step_factor=2.0,
+        step_duration_s=1.0, slo_ms=120.0, shed_threshold=0.05,
+        bisect_rounds=2, timeout_s=1.5, seed=23,
+    )
+    result = find_knee(_CliffTarget(capacity), cfg)
+    assert result.saturated, "cliff at 80 rps was never confirmed"
+    assert 0.4 * capacity <= result.knee_rps <= 1.3 * capacity, \
+        f"knee {result.knee_rps:.1f} not near capacity {capacity}"
+    # the curve shows the breach structure the bisection used
+    assert any(p.breached for p in result.curve)
+    assert any(not p.breached for p in result.curve)
+    assert any(p.stage == "bisect" for p in result.curve)
+    # knee gauge + event landed
+    assert metrics.snapshot()["gauges"]["loadgen/knee_rps"] == \
+        pytest.approx(result.knee_rps)
+
+    # offline CLI reconstructs the curve from raw request records
+    path = str(tmp_path / "results.jsonl")
+    write_results(path, result)
+    with open(path) as fh:
+        records = [json.loads(line) for line in fh if line.strip()]
+    requests = [r for r in records if r["kind"] == "request"]
+    curve = reconstruct_curve(requests)
+    assert {round(p["rps"], 3) for p in curve} == \
+        {round(p.rps, 3) for p in result.curve}
+    text = render_report(path)
+    assert f"{result.knee_rps:.1f} rps" in text
+    assert "saturated" in text
+
+
+def test_knee_finder_unsaturated_below_max_rps():
+    class _FastTarget:
+        def fire(self, event, timeout_s):
+            return {"status": "ok"}
+
+    cfg = KneeConfig(
+        start_rps=20.0, max_rps=60.0, step_factor=2.0,
+        step_duration_s=0.4, slo_ms=500.0, shed_threshold=0.5,
+        bisect_rounds=1, timeout_s=1.0, seed=29,
+    )
+    result = find_knee(_FastTarget(), cfg)
+    assert not result.saturated
+    assert result.knee_rps > 0
+
+
+# ---------------------------------------------------------------------
+# provenance: phase decomposition sums; histogram quantile exactness
+# ---------------------------------------------------------------------
+
+
+def test_request_phases_sum_to_total():
+    req = ServeRequest([1] * 6, timeout_s=5.0)
+    req.enqueued_mono = 100.0
+    req.dequeued_mono = 100.020
+    req.dispatched_mono = 100.025
+    req.exec_s = 0.010
+    req.bucket = 16
+    phases = request_phases(req, 100.040)
+    assert phases["queue_wait"] == pytest.approx(0.020)
+    assert phases["linger"] == pytest.approx(0.005)
+    assert phases["execute"] == pytest.approx(0.010)
+    assert phases["reply"] == pytest.approx(0.005)
+    four = (phases["queue_wait"] + phases["linger"]
+            + phases["execute"] + phases["reply"])
+    assert four == pytest.approx(phases["total"])
+    # padding waste is the pad-row slice of execute: 1 - 6/16
+    assert phases["padding_waste"] == pytest.approx(0.010 * (1 - 6 / 16))
+
+
+def test_request_phases_none_when_never_dequeued():
+    req = ServeRequest([1], timeout_s=1.0)
+    assert request_phases(req, time.monotonic()) is None
+
+
+def test_histogram_quantile_merges_exactly():
+    a, b = Histogram(), Histogram()
+    for v in (0.001, 0.003, 0.004):
+        a.observe(v)
+    for v in (0.04, 0.07, 0.3, 2.0):
+        b.observe(v)
+    assert a.quantile(0.5) is not None
+    assert Histogram().quantile(0.99) is None
+    # stat-wise merged summary (what ClusterTelemetry does) yields the
+    # same quantile as observing everything in one histogram
+    merged = {"sum": 0.0, "count": 0.0, "buckets": {}}
+    for h in (a, b):
+        s = h.summary()
+        merged["sum"] += s["sum"]
+        merged["count"] += s["count"]
+        for le, c in s["buckets"].items():
+            merged["buckets"][le] = merged["buckets"].get(le, 0.0) + c
+    one = Histogram()
+    for v in (0.001, 0.003, 0.004, 0.04, 0.07, 0.3, 2.0):
+        one.observe(v)
+    assert quantile_from_hist_summary(merged, 0.99) == \
+        pytest.approx(one.quantile(0.99))
+    assert quantile_from_hist_summary(merged, 0.5) == \
+        pytest.approx(one.quantile(0.5))
+
+
+def test_phase_breakdown_from_records():
+    records = [
+        {"kind": "request", "status": "ok", "latency_s": 0.1,
+         "step_rps": 10.0,
+         "phases": {"queue_wait": 0.02, "linger": 0.01,
+                    "execute": 0.05, "reply": 0.02,
+                    "padding_waste": 0.01, "total": 0.1}},
+        {"kind": "request", "status": "ok", "latency_s": 0.2,
+         "step_rps": 10.0,
+         "phases": {"queue_wait": 0.08, "linger": 0.02,
+                    "execute": 0.08, "reply": 0.02,
+                    "padding_waste": 0.0, "total": 0.2}},
+    ]
+    bd = phase_breakdown(records)
+    assert bd["queue_wait"]["mean_s"] == pytest.approx(0.05)
+    # fractions over the 4 additive phases sum to ~1 (padding_waste
+    # is informational, inside execute)
+    additive = sum(
+        bd[name]["fraction"]
+        for name in ("queue_wait", "linger", "execute", "reply")
+    )
+    assert additive == pytest.approx(1.0, abs=0.01)

@@ -377,3 +377,121 @@ def test_sigterm_drains_in_flight_batch():
         snap = metrics.snapshot()["counters"]
         assert snap.get("serve/errors", 0) == 0
         assert snap["serve/replies"] == 12
+
+
+# ---------------------------------------------------------------------
+# correlation headers, phase provenance, cold-start null guards
+# ---------------------------------------------------------------------
+
+
+class _PhasedGroup:
+    """Echo stub whose replies carry a phase decomposition."""
+
+    def submit(self, payload, timeout_s=None, request_id=None):
+        req = ServeRequest(payload, timeout_s=timeout_s,
+                           request_id=request_id)
+        req.attempts = 1
+        req.result = sum(payload)
+        req.phases = {"queue_wait": 0.01, "linger": 0.002,
+                      "execute": 0.03, "reply": 0.008,
+                      "padding_waste": 0.004, "total": 0.05}
+        req.replied = True
+        req.done.set()
+        return req
+
+    def stats(self):
+        return {"replicas_alive": 1}
+
+
+def test_predict_response_carries_request_id_and_phases():
+    fe = ServeFrontend(_PhasedGroup())
+    status, payload, headers = fe.handle_predict(
+        {"inputs": [1, 2], "id": "req-abc"}
+    )
+    assert status == 200
+    assert headers["X-RayDP-Request-Id"] == "req-abc"
+    assert payload["id"] == "req-abc"
+    phases = payload["phases"]
+    four = (phases["queue_wait"] + phases["linger"]
+            + phases["execute"] + phases["reply"])
+    assert four == pytest.approx(phases["total"])
+
+
+def test_predict_echoes_incoming_traceparent():
+    fe = ServeFrontend(_PhasedGroup())
+    status, _, headers = fe.handle_predict(
+        {"inputs": [1]}, headers={"Traceparent": "trace01;span02"}
+    )
+    assert status == 200
+    assert headers["traceparent"] == "trace01;span02"
+    assert "X-RayDP-Request-Id" in headers
+
+
+def test_predict_504_carries_request_id_and_event():
+    from raydp_tpu.telemetry import events as _events
+
+    class _Stuck:
+        def submit(self, payload, timeout_s=None, request_id=None):
+            return ServeRequest(payload, timeout_s=0.05,
+                                request_id=request_id)
+
+        def stats(self):
+            return {}
+
+    status, payload, headers = ServeFrontend(_Stuck()).handle_predict(
+        {"inputs": [1], "id": "slow-1"}
+    )
+    assert status == 504
+    assert headers["X-RayDP-Request-Id"] == "slow-1"
+    timeouts = [e for e in _events.local_events()
+                if e["name"] == "serve/timeout"]
+    assert timeouts
+    assert timeouts[-1]["attrs"]["request_id"] == "slow-1"
+
+
+def test_predict_429_echoes_client_supplied_id():
+    fe = ServeFrontend(_ShedGroup(QueueFullError("full", 5, 1.0)))
+    _, _, headers = fe.handle_predict({"inputs": [1], "id": "mine"})
+    assert headers["X-RayDP-Request-Id"] == "mine"
+    assert headers["Retry-After"] == "1"
+
+
+def test_cold_group_stats_are_null_not_nan():
+    group = ReplicaGroup(replicas=1, model_fn=_make_model(),
+                         label="t-cold")
+    stats = group.stats()  # zero replies ever: nulls, no KeyError
+    assert stats["latency_p50_s"] is None
+    assert stats["latency_p99_s"] is None
+    assert stats["per_replica"] == {}
+    for phase in ("queue_wait", "linger", "execute", "reply"):
+        assert stats["phases"][phase]["mean_s"] is None
+        assert stats["phases"][phase]["p99_s"] is None
+    # the whole document survives JSON (no NaN/Inf leaks)
+    json.dumps(stats, allow_nan=False)
+
+
+def test_cold_serve_stats_http_is_200():
+    group = ReplicaGroup(replicas=1, model_fn=_make_model(),
+                         label="t-cold-http")
+    fe = ServeFrontend(group).start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{fe.port}/serve/stats", timeout=5
+        ) as resp:
+            doc = json.loads(resp.read())
+        assert doc["latency_p99_s"] is None
+        assert doc["replies"] == 0
+    finally:
+        fe.close()
+
+
+def test_cold_queue_eta_is_positive_before_any_reply():
+    q = RequestQueue(max_depth=1, slo_ms=25, max_batch=4)
+    # EWMA is SLO-seeded: the very first shed carries a usable ETA
+    assert q.shed_eta_s() > 0
+    q.submit(ServeRequest([1]))
+    with pytest.raises(QueueFullError) as ei:
+        q.submit(ServeRequest([2]))
+    assert ei.value.eta_s is not None and ei.value.eta_s > 0
+    from raydp_tpu.serve.frontend import retry_after_s
+    assert retry_after_s(ei.value) >= 1
