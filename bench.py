@@ -2521,6 +2521,70 @@ def bench_autoscale():
         control.reset_for_tests()
 
 
+def bench_scale_sim():
+    """Control-plane observatory evidence (doc/simulation.md): replay
+    a frozen million-arrival diurnal trace over a thousand simulated
+    hosts through the *real* arbiter/autoscaler/serve-queue code on
+    the virtual clock. ``events_per_sec`` is the simulator's
+    throughput headline; the virtual knee over the LOAD_SMOKE-shaped
+    service model (2 hosts, batch 1, 12 ms/call) anchors the
+    sim-vs-real cross-check; pathology and invariant counts must stay
+    zero on the healthy trace — a nonzero here is a control-plane
+    regression, not noise."""
+    from raydp_tpu import control
+    from raydp_tpu.loadgen.knee import KneeConfig
+    from raydp_tpu.loadgen.schedules import diurnal_schedule
+    from raydp_tpu.sim import ScenarioConfig, run_trace, sim_knee
+    from raydp_tpu.utils.profiling import metrics as _metrics
+
+    control.reset_for_tests()
+    _metrics.reset()
+
+    # Frozen trace: same seed every run, so events/sec and pathology
+    # counts diff cleanly across revisions.
+    events = diurnal_schedule(5000.0, 200.0, seed=1)
+    result = run_trace(events, ScenarioConfig(
+        hosts=1000, max_batch=8, max_queue=4096, slo_ms=250.0,
+        timeout_s=5.0,
+    ))
+    if result.completed != result.arrivals:
+        raise RuntimeError(
+            f"scale_sim bench: {result.arrivals - result.completed} of "
+            f"{result.arrivals} arrivals did not complete"
+        )
+
+    knee = sim_knee(
+        ScenarioConfig(hosts=2, max_batch=1, service_ms=12.0,
+                       slo_ms=5.0, max_queue=512, timeout_s=5.0),
+        KneeConfig(start_rps=8.0, max_rps=512.0, step_factor=2.0,
+                   step_duration_s=1.5, slo_ms=150.0,
+                   shed_threshold=0.05, bisect_rounds=2, seed=0),
+    )
+
+    pathology_counts: dict = {}
+    for p in result.pathologies:
+        pathology_counts[p["kind"]] = (
+            pathology_counts.get(p["kind"], 0) + p["count"]
+        )
+    return {
+        "arrivals": result.arrivals,
+        "hosts": 1000,
+        "completed": result.completed,
+        "shed": result.shed,
+        "virtual_s": round(result.duration_s, 1),
+        "wall_s": round(result.wall_s, 2),
+        "events_processed": result.events_processed,
+        "events_per_sec": round(result.events_per_s, 1),
+        "p50_ms": result.p50_ms,
+        "p99_ms": result.p99_ms,
+        "invariant_violations": len(result.invariant_violations),
+        "pathology_counts": pathology_counts,
+        "knee_rps": knee["knee_rps"],
+        "knee_saturated": knee["saturated"],
+        "knee_steps": knee["steps"],
+    }
+
+
 # ----------------------------------------------------------- main
 
 # The CPU matrix runs in THIS process (pinned to the CPU platform —
@@ -2560,6 +2624,10 @@ CPU_MATRIX = [
     # Self-sizing pool: time-to-scale-up, graceful-drain latency, and
     # flap count against a real worker pool (doc/scheduling.md).
     ("autoscale", bench_autoscale),
+    # Virtual-clock observatory: million-arrival replay through the
+    # real control plane — events/sec throughput, sim knee, pathology
+    # counts (doc/simulation.md). Host-side, deterministic.
+    ("scale_sim", bench_scale_sim),
     # Ingest is bandwidth-sensitive: keep it ahead of the model configs
     # that leave host-memory pressure behind.
     ("ingest_device_feed", bench_ingest),

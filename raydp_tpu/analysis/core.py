@@ -229,6 +229,7 @@ def _rule_modules():
         rules_locks,
         rules_rpc,
         rules_signals,
+        rules_simclock,
         rules_telemetry,
     )
 
@@ -238,6 +239,7 @@ def _rule_modules():
         "R3": rules_rpc,
         "R4": rules_telemetry,
         "R5": rules_jax,
+        "R6": rules_simclock,
     }
 
 
@@ -248,6 +250,8 @@ RULES = {
           "registered or inflight()-bracketed",
     "R4": "telemetry consistency: metric/family/env-var doc parity",
     "R5": "jax hazards: host syncs in jit/step loops, missing donation",
+    "R6": "clock-seam discipline: no direct time.monotonic/sleep in "
+          "simulable modules (control/, serve/batching.py, sim/)",
 }
 
 

@@ -59,12 +59,12 @@ import contextlib
 import itertools
 import os
 import threading
-import time
 from typing import Any, Callable, Dict, List, Optional
 
 from raydp_tpu.telemetry import accounting as _acct
 from raydp_tpu.telemetry import events as _events
 from raydp_tpu.telemetry import watchdog as _watchdog
+from raydp_tpu.utils import clock as _clock
 from raydp_tpu.utils.profiling import metrics as _metrics
 
 __all__ = [
@@ -171,7 +171,7 @@ class Lease:
         self.inert = inert  # disabled arbiter: every operation no-ops
         self.active = True
         self.preempt_requested = False
-        self.granted_mono = time.monotonic()
+        self.granted_mono = _clock.monotonic()
         self.renewed_mono = self.granted_mono
         self._on_preempt: Optional[Callable[[], None]] = None
 
@@ -181,7 +181,7 @@ class Lease:
         self._on_preempt = callback
 
     def renew(self) -> None:
-        self.renewed_mono = time.monotonic()
+        self.renewed_mono = _clock.monotonic()
 
     def release(self, state: str = COMPLETED) -> None:
         """Return the slots; ``state`` records why (``completed`` for a
@@ -211,7 +211,7 @@ class _Waiter:
         self.job = job
         self.slots = slots
         self.seq = seq
-        self.enqueued_mono = time.monotonic()
+        self.enqueued_mono = _clock.monotonic()
         self.granted = False
         self.shed_reason: Optional[str] = None
 
@@ -263,7 +263,9 @@ class ClusterArbiter:
         self._wait_samples: "collections.deque[float]" = collections.deque(
             maxlen=_WAIT_WINDOW
         )
-        self._preempt_timers: Dict[int, threading.Timer] = {}
+        # Timer-shaped handles from _clock.call_later (threading.Timer
+        # on the real clock, virtual-event handles under the sim).
+        self._preempt_timers: Dict[int, Any] = {}
 
     # -- public surface -------------------------------------------------
 
@@ -317,7 +319,7 @@ class ClusterArbiter:
                     depth=len(self._waiters), priority=job.priority,
                 )
             self._publish_depth_locked()
-            deadline = time.monotonic() + timeout
+            deadline = _clock.monotonic() + timeout
             preempt_fired = False
             try:
                 with _watchdog.inflight(
@@ -332,20 +334,22 @@ class ClusterArbiter:
                             preempt_fired = self._maybe_preempt_locked(
                                 waiter
                             )
-                        now = time.monotonic()
+                        now = _clock.monotonic()
                         if now >= deadline:
                             raise self._busy_locked(
                                 f"admission timed out after {timeout:.1f}s "
                                 f"for job {job.job_id} "
                                 f"({slots} slot(s), kind={kind})"
                             )
-                        self._mu.wait(timeout=min(0.2, deadline - now))
+                        _clock.wait_on(
+                            self._mu, timeout=min(0.2, deadline - now)
+                        )
                         self._reap_expired_locked()
             finally:
                 if waiter in self._waiters:
                     self._waiters.remove(waiter)
                 self._publish_depth_locked()
-            waited = time.monotonic() - waiter.enqueued_mono
+            waited = _clock.monotonic() - waiter.enqueued_mono
             self._wait_samples.append(waited)
             _metrics.counter_add(f"sched/wait/{job.job_id}", waited)
             lease = Lease(self, job, slots, kind, label, preemptible)
@@ -421,7 +425,7 @@ class ClusterArbiter:
                         "priority": w.job.priority,
                         "slots": w.slots,
                         "waited_s": round(
-                            time.monotonic() - w.enqueued_mono, 3
+                            _clock.monotonic() - w.enqueued_mono, 3
                         ),
                     }
                     for w in self._order_locked(self._waiters)
@@ -429,13 +433,14 @@ class ClusterArbiter:
                 "leases": [
                     {
                         "job": l.job.job_id,
+                        "priority": l.job.priority,
                         "kind": l.kind,
                         "label": l.label,
                         "slots": l.slots,
                         "preemptible": l.preemptible,
                         "preempt_requested": l.preempt_requested,
                         "held_s": round(
-                            time.monotonic() - l.granted_mono, 3
+                            _clock.monotonic() - l.granted_mono, 3
                         ),
                     }
                     for l in self._leases
@@ -499,7 +504,7 @@ class ClusterArbiter:
         (``reason="pressure"``). Returns True when a preemption was
         initiated (one per waiter: re-preempting while the first victim
         drains would cascade)."""
-        waited = time.monotonic() - waiter.enqueued_mono
+        waited = _clock.monotonic() - waiter.enqueued_mono
         pressure = self.pressure_s > 0 and waited >= self.pressure_s
         candidates = [
             l for l in self._leases
@@ -529,19 +534,16 @@ class ClusterArbiter:
         )
         callback = victim._on_preempt
         if callback is not None:
-            # Off-lock, off-thread: the callback SIGTERMs gang ranks /
+            # Off-lock, off-stack: the callback SIGTERMs gang ranks /
             # touches RPC; holding the arbiter lock through that would
             # serialize the whole control plane behind it.
-            threading.Thread(
-                target=self._run_preempt_callback,
-                args=(victim, callback), daemon=True,
+            _clock.defer(
+                lambda: self._run_preempt_callback(victim, callback),
                 name="raydp-sched-preempt",
-            ).start()
-        timer = threading.Timer(
-            self.preempt_timeout_s, self._preempt_deadline, args=(victim,)
+            )
+        timer = _clock.call_later(
+            self.preempt_timeout_s, self._preempt_deadline, victim
         )
-        timer.daemon = True
-        timer.start()
         self._preempt_timers[id(victim)] = timer
         return True
 
@@ -575,7 +577,7 @@ class ClusterArbiter:
         enough to care."""
         if self.lease_ttl_s <= 0:
             return
-        now = time.monotonic()
+        now = _clock.monotonic()
         expired = [
             l for l in self._leases
             if now - l.renewed_mono > self.lease_ttl_s
@@ -633,7 +635,7 @@ class ClusterArbiter:
         shallow stuck one."""
         if not self._waiters:
             return 0.0
-        now = time.monotonic()
+        now = _clock.monotonic()
         return round(
             max(now - w.enqueued_mono for w in self._waiters), 4
         )
@@ -665,7 +667,7 @@ class ClusterArbiter:
             "sched/release" if state == COMPLETED else "sched/drain",
             job=lease.job, slots=lease.slots, lease_kind=lease.kind,
             state=state,
-            held_s=round(time.monotonic() - lease.granted_mono, 4),
+            held_s=round(_clock.monotonic() - lease.granted_mono, 4),
         )
         self._grant_locked()
         self._mu.notify_all()

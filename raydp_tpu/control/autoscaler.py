@@ -42,11 +42,11 @@ from __future__ import annotations
 import logging
 import os
 import threading
-import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from raydp_tpu.telemetry import events as _events
+from raydp_tpu.utils import clock as _clock
 from raydp_tpu.utils.profiling import metrics as _metrics
 
 logger = logging.getLogger(__name__)
@@ -281,7 +281,7 @@ class Autoscaler:
         """One normalized reading of every pressure source. Each key
         is already divided by its reference, so ``max(values)`` is the
         backlog score the thresholds compare against."""
-        now = time.monotonic()
+        now = _clock.monotonic()
         sig: Dict[str, float] = {}
         try:
             from raydp_tpu.control.arbiter import get_arbiter
@@ -365,7 +365,7 @@ class Autoscaler:
 
     def _step_locked(self) -> Decision:
         cfg = self.config
-        now = time.monotonic()
+        now = _clock.monotonic()
         signals = self.sample_pressure()
         pressure = max(signals.values()) if signals else 0.0
         size = len(self.provisioner.hosts())
@@ -482,14 +482,14 @@ class Autoscaler:
                             pressure, size, size + n, signals,
                         )
                     delay = cfg.backoff_s * (2 ** (attempts - 1))
-                    if self._stopping.wait(timeout=delay):
+                    if _clock.wait_event(self._stopping, timeout=delay):
                         return Decision(
                             "failed", "stopped during spawn backoff",
                             pressure, size, size + n, signals,
                         )
         finally:
             _metrics.gauge_set("autoscale/pending_spawns", 0.0)
-        self._last_grow_mono = time.monotonic()
+        self._last_grow_mono = _clock.monotonic()
         self._idle_streak = 0
         _metrics.counter_add("autoscale/decisions/grow")
         _metrics.gauge_set(
@@ -542,7 +542,7 @@ class Autoscaler:
         if drained == 0:
             return self._deny("no drainable victim", pressure, size,
                               signals)
-        self._last_shrink_mono = time.monotonic()
+        self._last_shrink_mono = _clock.monotonic()
         self._idle_streak = 0
         _metrics.counter_add("autoscale/decisions/shrink")
         _metrics.gauge_set(

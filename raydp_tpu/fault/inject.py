@@ -21,6 +21,7 @@ import time
 from typing import List, Optional
 
 from raydp_tpu.fault.plan import FAULT_PLAN_ENV, FaultClause, parse_plan
+from raydp_tpu.utils import clock as _clock
 
 PREEMPT_GRACE_ENV = "RAYDP_TPU_PREEMPT_GRACE_S"
 
@@ -119,6 +120,14 @@ def active() -> bool:
     return bool(os.environ.get("RAYDP_TPU_FAULT_PLAN"))
 
 
+def plan_clauses() -> List[FaultClause]:
+    """The active plan's parsed clauses (shared, mutable — marking one
+    ``fired`` consumes it process-wide). The simulator uses this to
+    honor ``serve_kill``/``latency`` clauses on virtual time with
+    simulated deaths instead of the process-killing ``_die`` path."""
+    return _clauses()
+
+
 def _emit_clause(clause: FaultClause, what: str) -> None:
     """Timeline record of a clause firing — the injected cause lands in
     /debug/events next to the gang churn it produces. Write-through
@@ -126,7 +135,10 @@ def _emit_clause(clause: FaultClause, what: str) -> None:
     try:
         from raydp_tpu.telemetry import events as _events
 
-        _events.emit("fault/clause", kind=clause.kind, what=what)
+        # N.B. the attr must not be named "kind" — that is emit()'s
+        # first positional parameter and the call would TypeError
+        # (swallowed by the except below, losing the record).
+        _events.emit("fault/clause", clause=clause.kind, what=what)
     except Exception:
         pass
 
@@ -236,7 +248,9 @@ def on_serve_request(
                 f"replica {replica} stalled {c.delay}s "
                 f"at request {request_index}",
             )
-            time.sleep(c.delay)
+            # Via the clock seam: a latency clause inside a simulation
+            # stalls virtual time, not the wall.
+            _clock.sleep(c.delay)
 
 
 def on_rpc(qualified_method: str) -> Optional[str]:
@@ -287,7 +301,8 @@ def on_spawn() -> None:
         if c.kind == "spawn_delay":
             c.fired = True
             _emit_clause(c, f"delayed spawn attempt {n} by {c.delay}s")
-            time.sleep(c.delay)
+            # Clock seam: virtual under simulation, wall time otherwise.
+            _clock.sleep(c.delay)
         elif c.kind == "spawn_fail":
             c.fired = True
             _emit_clause(c, f"failed spawn attempt {n}")

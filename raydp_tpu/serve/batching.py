@@ -21,10 +21,10 @@ from __future__ import annotations
 import collections
 import os
 import threading
-import time
 import uuid
 from typing import Any, Deque, List, Optional, Sequence
 
+from raydp_tpu.utils import clock as _clock
 from raydp_tpu.utils.profiling import metrics
 
 SERVE_MAX_QUEUE_ENV = "RAYDP_TPU_SERVE_MAX_QUEUE"
@@ -106,7 +106,7 @@ class ServeRequest:
             self.length = len(payload)
         except TypeError:
             self.length = 1
-        self.enqueued_mono = time.monotonic()
+        self.enqueued_mono = _clock.monotonic()
         if timeout_s is None:
             timeout_s = _env_float(SERVE_TIMEOUT_ENV, _DEFAULT_TIMEOUT_S)
         self.deadline_mono = self.enqueued_mono + timeout_s
@@ -126,7 +126,7 @@ class ServeRequest:
 
     def remaining_s(self, now: Optional[float] = None) -> float:
         return self.deadline_mono - (now if now is not None
-                                     else time.monotonic())
+                                     else _clock.monotonic())
 
     def expired(self, now: Optional[float] = None) -> bool:
         return self.remaining_s(now) <= 0
@@ -139,7 +139,7 @@ class ServeRequest:
         if not self.done.wait(max(0.0, budget) + 0.05):
             raise RequestCancelled(
                 f"request {self.request_id} timed out after "
-                f"{time.monotonic() - self.enqueued_mono:.3f}s"
+                f"{_clock.monotonic() - self.enqueued_mono:.3f}s"
             )
         if self.cancelled:
             raise RequestCancelled(
@@ -282,7 +282,7 @@ class RequestQueue:
             self._mu.notify()
             observers = list(self._arrival_observers)
         if observers:
-            now = time.monotonic()
+            now = _clock.monotonic()
             for fn in observers:
                 try:
                     fn(req, now)
@@ -309,7 +309,7 @@ class RequestQueue:
         requests are not requeued; expired ones are cancelled so their
         submitter unblocks. Returns the number requeued."""
         n = 0
-        now = time.monotonic()
+        now = _clock.monotonic()
         with self._mu:
             for req in reversed(list(reqs)):
                 if req.replied:
@@ -346,20 +346,20 @@ class RequestQueue:
         requests until ``max_batch``. Expired requests are cancelled
         in place, never dispatched."""
         with self._mu:
-            deadline = time.monotonic() + wait_timeout
+            deadline = _clock.monotonic() + wait_timeout
             head = self._pop_live_locked()
             while head is None:
-                remaining = deadline - time.monotonic()
+                remaining = deadline - _clock.monotonic()
                 if remaining <= 0 or self._closed:
                     return []
-                self._mu.wait(timeout=remaining)
+                _clock.wait_on(self._mu, timeout=remaining)
                 head = self._pop_live_locked()
             bucket = self.bucket_for(head.length)
             batch = [head]
             # Linger window: bounded by the SLO and by how much slack
             # the head request has left — a nearly-expired head ships
             # immediately rather than dying in the linger.
-            linger_end = time.monotonic() + min(
+            linger_end = _clock.monotonic() + min(
                 self.slo_s, max(0.0, head.remaining_s() - self.slo_s)
             )
             while len(batch) < self.max_batch:
@@ -367,10 +367,10 @@ class RequestQueue:
                 if more is not None:
                     batch.append(more)
                     continue
-                remaining = linger_end - time.monotonic()
+                remaining = linger_end - _clock.monotonic()
                 if remaining <= 0:
                     break
-                self._mu.wait(timeout=remaining)
+                _clock.wait_on(self._mu, timeout=remaining)
             metrics.gauge_set("serve/queue_depth", len(self._pending))
             metrics.counter_add("serve/batches")
             metrics.counter_add("serve/batch_requests", len(batch))
@@ -382,7 +382,7 @@ class RequestQueue:
             return batch
 
     def _pop_live_locked(self) -> Optional[ServeRequest]:
-        now = time.monotonic()
+        now = _clock.monotonic()
         while self._pending:
             req = self._pending.popleft()
             if req.expired(now):
@@ -394,7 +394,7 @@ class RequestQueue:
         return None
 
     def _pop_bucket_locked(self, bucket: int) -> Optional[ServeRequest]:
-        now = time.monotonic()
+        now = _clock.monotonic()
         for i, req in enumerate(self._pending):
             if req.expired(now):
                 continue  # swept by the next _pop_live_locked pass
@@ -428,7 +428,7 @@ class RequestQueue:
             req.replied = True
         req.result = result
         req.error = error
-        now = time.monotonic()
+        now = _clock.monotonic()
         if error is not None:
             metrics.counter_add("serve/errors")
         else:

@@ -139,6 +139,23 @@ def build(
     if scheduler:
         control["scheduler"] = scheduler
 
+    # Observatory panel: what the virtual-clock simulator saw. Only
+    # present when a replay ran in this process (or its counters were
+    # merged in) so live dashboards without simulation stay unchanged.
+    sim = {
+        "arrivals": g("sim/arrivals"),
+        "completed": g("sim/completed"),
+        "shed": g("sim/shed"),
+        "invariant_violations": g("sim/invariant_violations"),
+        "pathologies": _collect_prefix(flat, "sim/pathologies/"),
+        "knee_rps": _rounded(g("sim/knee_rps")),
+        "events_per_sec": _rounded(g("sim/events_per_s")),
+        "replica_deaths": g("sim/replica_deaths"),
+    }
+    has_sim = any(
+        value not in (None, {}) for value in sim.values()
+    )
+
     if events is None:
         events = _events.local_events()
     tail = [
@@ -152,7 +169,7 @@ def build(
     ]
     mttr = _events.mttr_report(events)
 
-    return {
+    doc: Dict[str, Any] = {
         "generated_wall": time.time(),
         "train": train,
         "etl": etl,
@@ -166,6 +183,9 @@ def build(
             else (lambda s: s.stats() if s else {})(active_store())
         ),
     }
+    if has_sim:
+        doc["sim"] = sim
+    return doc
 
 
 def local_dashboard() -> Dict[str, Any]:
@@ -209,6 +229,8 @@ def format_dashboard(dash: Dict[str, Any]) -> str:
         ("control", "control"),
     ):
         lines.extend(_section(title, dash.get(key) or {}))
+    if dash.get("sim"):
+        lines.extend(_section("sim", dash["sim"]))
 
     slo = dash.get("slo") or {}
     lines.append("== slo ==")
@@ -291,6 +313,36 @@ def _offline_dashboard(directory: str) -> Dict[str, Any]:
     timeline tail, MTTR episodes, and the SLO breach/recovery events."""
     records = _events.load_event_records(directory)
     empty_view: Dict[str, Any] = {"workers": {}, "aggregate": {}, "driver": {}}
+    # Simulator episode story: the sim/* events a replay wrote through
+    # become the offline sim panel (violations, pathology episodes,
+    # last run's headline numbers).
+    sim_rows: Dict[str, Any] = {
+        "pathologies": {}, "invariant_violations": 0,
+    }
+    saw_sim = False
+    for rec in records:
+        name = rec.get("name")
+        attrs = rec.get("attrs") or {}
+        if name == "sim/run":
+            saw_sim = True
+            sim_rows.update(
+                arrivals=attrs.get("arrivals"),
+                completed=attrs.get("completed"),
+                shed=attrs.get("shed"),
+                events_per_sec=attrs.get("events_per_s"),
+            )
+        elif name == "sim/invariant":
+            saw_sim = True
+            sim_rows["invariant_violations"] += 1
+        elif name == "sim/pathology":
+            saw_sim = True
+            kind = attrs.get("pathology") or "?"
+            sim_rows["pathologies"][kind] = (
+                sim_rows["pathologies"].get(kind, 0) + 1
+            )
+        elif name == "sim/knee":
+            saw_sim = True
+            sim_rows["knee_rps"] = attrs.get("knee_rps")
     slo_rows: Dict[str, Any] = {}
     for rec in records:
         if rec.get("name") not in ("slo/breach", "slo/recovered"):
@@ -312,9 +364,12 @@ def _offline_dashboard(directory: str) -> Dict[str, Any]:
         else:
             row["status"] = "ok"
             row["last_mttr_s"] = attrs.get("mttr_s")
-    return build(
+    dash = build(
         empty_view, events=records, ts_stats={}, slo=slo_rows,
     )
+    if saw_sim:
+        dash["sim"] = sim_rows
+    return dash
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -614,6 +614,39 @@ def render_prometheus(view: Dict[str, Any]) -> str:
         "Breach episodes opened per objective (each also emits an "
         "slo/breach timeline event with auto-triage context).",
     )
+    sim_requests = _Family(
+        "raydp_sim_requests_total", "counter",
+        "Simulator request accounting by outcome "
+        "(arrivals|completed|shed) across every run_trace replay in "
+        "this process (doc/simulation.md).",
+    )
+    sim_invariants = _Family(
+        "raydp_sim_invariant_violations_total", "counter",
+        "Safety-invariant violations observed by the simulation's "
+        "live monitors (capacity overcommit, starvation, pool bounds, "
+        "duplicate replies, conservation). Nonzero is always a bug.",
+    )
+    sim_pathologies = _Family(
+        "raydp_sim_pathologies_total", "counter",
+        "Detected pathology episodes by kind (resonance, shed_storm, "
+        "priority_inversion, fragmentation) from post-run timeline "
+        "scans.",
+    )
+    sim_replica_lifecycle = _Family(
+        "raydp_sim_replica_lifecycle_total", "counter",
+        "Virtual-replica fault events (event=death|respawn) from "
+        "serve_kill clauses honored on virtual time.",
+    )
+    sim_knee = _Family(
+        "raydp_sim_knee_rps", "gauge",
+        "Capacity knee from the most recent virtual-time sweep "
+        "(sim_knee): the sim-side twin of raydp_loadgen_knee_rps.",
+    )
+    sim_events_rate = _Family(
+        "raydp_sim_events_per_second", "gauge",
+        "Simulator throughput: virtual events processed per wall "
+        "second in the most recent replay.",
+    )
     serve_counter_routes = {
         "serve/requests": serve_requests,
         "serve/replies": serve_replies,
@@ -845,6 +878,35 @@ def render_prometheus(view: Dict[str, Any]) -> str:
                             {"worker": worker_id}, section[name]
                         )
                         continue
+                    if name in ("sim/arrivals", "sim/completed",
+                                "sim/shed"):
+                        sim_requests.add(
+                            {"worker": worker_id,
+                             "outcome": name[len("sim/"):]},
+                            section[name],
+                        )
+                        continue
+                    if name == "sim/invariant_violations":
+                        sim_invariants.add(
+                            {"worker": worker_id}, section[name]
+                        )
+                        continue
+                    if name.startswith("sim/pathologies/"):
+                        sim_pathologies.add(
+                            {"worker": worker_id,
+                             "kind": name[len("sim/pathologies/"):]},
+                            section[name],
+                        )
+                        continue
+                    if name in ("sim/replica_deaths",
+                                "sim/replica_respawns"):
+                        sim_replica_lifecycle.add(
+                            {"worker": worker_id,
+                             "event": ("death" if name.endswith("deaths")
+                                       else "respawn")},
+                            section[name],
+                        )
+                        continue
                     if name.startswith("loadgen/status/"):
                         loadgen_requests.add(
                             {"worker": worker_id,
@@ -899,6 +961,10 @@ def render_prometheus(view: Dict[str, Any]) -> str:
                         loadgen_achieved_rps.add({"worker": worker_id}, value)
                     elif name == "loadgen/knee_rps":
                         loadgen_knee_rps.add({"worker": worker_id}, value)
+                    elif name == "sim/knee_rps":
+                        sim_knee.add({"worker": worker_id}, value)
+                    elif name == "sim/events_per_s":
+                        sim_events_rate.add({"worker": worker_id}, value)
                     elif name == "mfu":
                         mfu.add({"worker": worker_id}, value)
                     elif name.startswith("slo/status/"):

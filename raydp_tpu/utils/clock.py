@@ -1,0 +1,157 @@
+"""Injectable control-plane clock: the seam the simulator drives.
+
+Every time-dependent decision in the control plane — arbiter admission
+deadlines and TTL reaping, autoscaler cooldowns and spawn backoff,
+continuous-batching lingers — used to read ``time.monotonic()`` and
+block on ``Condition.wait`` directly, welding policy code to wall
+time. This module is the single indirection layer between that code
+and the clock: the default :class:`Clock` delegates straight to the
+``time``/``threading`` primitives it replaced (bit-identical behaviour
+when nothing is installed), while :mod:`raydp_tpu.sim` installs a
+virtual clock that advances time by pumping a discrete-event heap, so
+hours of simulated control-plane behaviour run in seconds of wall
+time.
+
+Contract for seam users (``control/``, ``serve/batching.py``,
+``sim/`` — enforced by raydpcheck rule R6):
+
+* read time via :func:`monotonic`, never ``time.monotonic()``;
+* block on a condition via :func:`wait_on` (spurious wakeups allowed —
+  callers must re-check their predicate in a loop, which they already
+  do for ``Condition.wait``);
+* block on an event via :func:`wait_event`;
+* delay a callback via :func:`call_later` (returns a Timer-shaped
+  handle with ``cancel()``);
+* run a callback off the current call stack via :func:`defer`
+  (replaces one-shot daemon threads).
+
+Installation is process-global and not reentrant: :func:`install`
+while a non-default clock is active raises, so a crashed simulation
+cannot silently leave the control plane on frozen time —
+:func:`uninstall` in a ``finally`` is part of the sim harness
+contract.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Optional
+
+__all__ = [
+    "Clock",
+    "install",
+    "uninstall",
+    "installed",
+    "is_virtual",
+    "monotonic",
+    "sleep",
+    "wait_on",
+    "wait_event",
+    "call_later",
+    "defer",
+]
+
+
+class Clock:
+    """Real-time default implementation and the interface virtual
+    clocks subclass. Each method maps 1:1 onto the primitive it
+    replaced at the call sites."""
+
+    def monotonic(self) -> float:
+        return time.monotonic()
+
+    def sleep(self, seconds: float) -> None:
+        time.sleep(seconds)
+
+    def wait_on(self, cond: "threading.Condition",
+                timeout: Optional[float] = None) -> bool:
+        """``cond.wait(timeout)`` — caller holds the condition's lock
+        and loops on its predicate (spurious wakeups allowed)."""
+        return cond.wait(timeout=timeout)
+
+    def wait_event(self, event: "threading.Event",
+                   timeout: Optional[float] = None) -> bool:
+        """``event.wait(timeout)`` — True when the event is set."""
+        return event.wait(timeout=timeout)
+
+    def call_later(self, delay: float, fn: Callable[..., None],
+                   *args: Any) -> Any:
+        """Schedule ``fn(*args)`` after ``delay`` seconds; returns a
+        handle with ``cancel()`` (a daemon ``threading.Timer`` here)."""
+        timer = threading.Timer(delay, fn, args=args)
+        timer.daemon = True
+        timer.start()
+        return timer
+
+    def defer(self, fn: Callable[[], None],
+              name: str = "raydp-clock-defer") -> None:
+        """Run ``fn`` off the current call stack (a one-shot daemon
+        thread here; an immediate event on a virtual clock)."""
+        threading.Thread(target=fn, daemon=True, name=name).start()
+
+
+_real = Clock()
+_installed: Clock = _real
+_mu = threading.Lock()
+
+
+def install(clock: Clock) -> None:
+    """Make ``clock`` the process clock. Raises when a non-default
+    clock is already installed (no nesting — a leaked install is a
+    bug, not a feature)."""
+    global _installed
+    with _mu:
+        if _installed is not _real:
+            raise RuntimeError(
+                "a virtual clock is already installed; uninstall() the "
+                "previous one first (sim harnesses must uninstall in a "
+                "finally block)"
+            )
+        _installed = clock
+
+
+def uninstall() -> None:
+    """Restore the real-time clock (idempotent)."""
+    global _installed
+    with _mu:
+        _installed = _real
+
+
+def installed() -> Clock:
+    return _installed
+
+
+def is_virtual() -> bool:
+    """True while a non-default clock is installed — the cheap guard
+    real-time-only paths (daemon loops, HTTP servers) check before
+    assuming wall time."""
+    return _installed is not _real
+
+
+# -- module-level delegates (what the seamed call sites invoke) ---------
+
+
+def monotonic() -> float:
+    return _installed.monotonic()
+
+
+def sleep(seconds: float) -> None:
+    _installed.sleep(seconds)
+
+
+def wait_on(cond: "threading.Condition",
+            timeout: Optional[float] = None) -> bool:
+    return _installed.wait_on(cond, timeout)
+
+
+def wait_event(event: "threading.Event",
+               timeout: Optional[float] = None) -> bool:
+    return _installed.wait_event(event, timeout)
+
+
+def call_later(delay: float, fn: Callable[..., None], *args: Any) -> Any:
+    return _installed.call_later(delay, fn, *args)
+
+
+def defer(fn: Callable[[], None], name: str = "raydp-clock-defer") -> None:
+    _installed.defer(fn, name)

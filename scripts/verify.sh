@@ -848,6 +848,113 @@ stamp("load_smoke", {
 PYEOF
   rm -rf "$load_dir"
 fi
+# Sim smoke (HARD): the virtual-clock observatory (doc/simulation.md)
+# — a seeded 100k-arrival diurnal+flash trace (round-tripped through
+# the loadgen JSONL format) replays through the REAL
+# arbiter/autoscaler/serve-queue on virtual time in seconds of wall
+# clock with zero invariant violations and zero pathologies; a
+# deliberately undersized pool under the same flash crowd must trip
+# the shed-storm detector; and the virtual knee over the LOAD_SMOKE
+# topology must agree with the real knee that gate just measured
+# within 25% — the proof that the simulator predicts the same cliff
+# the hardware shows.
+if [ "$rc" -eq 0 ]; then
+  echo "--- sim smoke (virtual-clock replay + pathology + knee cross-check) ---"
+  sim_dir=$(mktemp -d)
+  JAX_PLATFORMS=cpu RAYDP_TPU_SIM_TRACE_DIR="$sim_dir" \
+    python - <<'PYEOF' \
+    && echo "SIM_SMOKE=ok" \
+    || { echo "SIM_SMOKE=failed"; rc=1; }
+import json
+import os
+
+from raydp_tpu.loadgen.knee import KneeConfig
+from raydp_tpu.loadgen.schedules import (
+    TraceEvent, diurnal_schedule, flash_crowd_schedule,
+)
+from raydp_tpu.loadgen.trace import read_trace, write_trace
+from raydp_tpu.sim import ScenarioConfig, run_trace, sim_knee
+
+# Seeded 100k-arrival trace: a diurnal day with a flash crowd riding
+# on top of it, round-tripped through the loadgen JSONL format so the
+# sim consumes exactly what the real replay harness would.
+diurnal = diurnal_schedule(1200.0, 70.0, seed=1)
+flash = flash_crowd_schedule(500.0, 30.0, seed=2, burst_mult=8.0)
+events = list(diurnal) + [
+    TraceEvent(t=e.t + 70.0, bucket=e.bucket, size=e.size)
+    for e in flash
+]
+assert len(events) >= 100_000, len(events)
+trace_path = os.path.join(os.environ["RAYDP_TPU_SIM_TRACE_DIR"],
+                          "smoke.jsonl")
+write_trace(trace_path, events)
+events = read_trace(trace_path)
+
+healthy = run_trace(events, ScenarioConfig(
+    hosts=16, max_batch=8, max_queue=4096, slo_ms=250.0,
+))
+assert healthy.completed == healthy.arrivals, (
+    healthy.arrivals, healthy.completed, healthy.shed, healthy.errors
+)
+assert healthy.invariant_violations == [], healthy.invariant_violations
+assert healthy.pathologies == [], healthy.pathologies
+assert healthy.wall_s < 60.0, healthy.wall_s
+
+# The same flash crowd over a deliberately undersized pool must trip
+# the shed-storm detector — the positive control for the pathology
+# plane.
+storm = run_trace(flash, ScenarioConfig(
+    hosts=1, max_batch=2, max_queue=64, slo_ms=50.0,
+))
+storm_kinds = {p["kind"] for p in storm.pathologies}
+assert "shed_storm" in storm_kinds, storm.pathologies
+
+# Virtual knee over the LOAD_SMOKE topology (2 replicas, batch 1,
+# 12ms/call, tiny linger): must land within 25% of the real knee the
+# load-smoke gate just measured on the same shape.
+knee = sim_knee(
+    ScenarioConfig(hosts=2, max_batch=1, service_ms=12.0, slo_ms=5.0,
+                   max_queue=512, timeout_s=5.0),
+    KneeConfig(start_rps=16.0, max_rps=1024.0, step_factor=2.0,
+               step_duration_s=1.0, slo_ms=150.0, shed_threshold=0.05,
+               bisect_rounds=2, timeout_s=5.0, seed=0),
+)
+assert knee["saturated"], knee
+
+real_knee = None
+metrics_path = os.environ.get("VERIFY_METRICS_PATH")
+if metrics_path and os.path.exists(metrics_path):
+    with open(metrics_path) as f:
+        doc = json.load(f)
+    real_knee = (doc.get("configs", {})
+                    .get("load_smoke", {})
+                    .get("knee_rps"))
+if real_knee:
+    gap = abs(knee["knee_rps"] - real_knee) / real_knee
+    assert gap <= 0.25, (
+        f"sim knee {knee['knee_rps']} vs real {real_knee} rps: "
+        f"{gap:.0%} apart (tolerance 25%)"
+    )
+else:
+    gap = None
+    print("sim smoke: no load_smoke stamp found; knee cross-check "
+          "skipped (standalone run)")
+
+exec(open("scripts/verify_metrics.py").read())
+stamp("sim_smoke", {
+    "arrivals": healthy.arrivals,
+    "wall_s": round(healthy.wall_s, 2),
+    "events_per_sec": round(healthy.events_per_s, 1),
+    "invariant_violations": len(healthy.invariant_violations),
+    "pathologies_healthy": len(healthy.pathologies),
+    "shed_storm_detected": 1 if "shed_storm" in storm_kinds else 0,
+    "knee_rps": knee["knee_rps"],
+    "real_knee_rps": real_knee,
+    "knee_gap_frac": round(gap, 4) if gap is not None else None,
+})
+PYEOF
+  rm -rf "$sim_dir"
+fi
 # Bench regression gate (ADVISORY): when two result files exist, diff
 # the newest pair; a >10% throughput/MFU regression prints loudly but
 # never fails the tier-1 gate (bench noise on shared CI boxes is real
