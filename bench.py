@@ -2415,6 +2415,123 @@ def bench_serve_load():
     }
 
 
+def bench_serve_decode():
+    """Decode-plane evidence (doc/serving.md#autoregressive-decode):
+    the same paged-KV round loop driving a tiny CausalLM, batched
+    (all slots admitted up front, continuous batching keeps them full)
+    vs one-request-at-a-time over the *same* engine — the replica's
+    step cost is fixed by its slot count, so serving sequentially
+    wastes the batch and the tokens/s ratio isolates what iteration-
+    level scheduling buys. Token-for-token parity between both arms is
+    the correctness gate; TTFT comes from the first streamed token of
+    each request, and per-round occupancy / KV page fill ride out as
+    ``raydp_decode_*`` telemetry families."""
+    from raydp_tpu.serve.decode import DecodeConfig, DecodeLoop
+    from raydp_tpu.serve.decode import build_transformer_engine
+    from raydp_tpu.telemetry import export as _export
+    from raydp_tpu.utils.profiling import metrics as _metrics
+
+    n_requests = 16
+    max_new = 32
+    num_slots = 8
+    prompts = [
+        [((3 * i + j) % 251) + 1 for j in range(4 + i % 5)]
+        for i in range(n_requests)
+    ]
+    engine = build_transformer_engine(
+        num_slots=num_slots, page_tokens=16, seed=0
+    )
+    config = DecodeConfig.from_env(round_linger_s=0.0)
+
+    def drive(batch):
+        """Run ``prompts`` to completion; ``batch`` submits them all
+        up front, else one at a time. Returns wall, streams, ttfts,
+        and per-round stats."""
+        streams: dict = {}
+        first_ts: dict = {}
+
+        def on_token(rid, index, token):
+            if index == 0:
+                first_ts[rid] = time.perf_counter()
+            streams.setdefault(rid, []).append(token)
+
+        loop = DecodeLoop(engine, config, on_token=on_token)
+        rounds = []
+        t0 = time.perf_counter()
+        if batch:
+            for i, p in enumerate(prompts):
+                loop.submit(f"b{i}", p, max_new=max_new)
+            while True:
+                stats = loop.run_round()
+                rounds.append(stats)
+                if stats["live"] == 0 and stats["pending"] == 0:
+                    break
+        else:
+            for i, p in enumerate(prompts):
+                loop.submit(f"b{i}", p, max_new=max_new)
+                while True:
+                    stats = loop.run_round()
+                    rounds.append(stats)
+                    if stats["live"] == 0 and stats["pending"] == 0:
+                        break
+        wall = time.perf_counter() - t0
+        ttfts = sorted(first_ts[rid] - t0 for rid in first_ts)
+        return wall, streams, ttfts, rounds
+
+    # One warm pass compiles prefill (bucket 16) and the decode step at
+    # every KV bucket the run will touch, so both arms time steady
+    # state, not XLA.
+    warm = DecodeLoop(engine, config)
+    warm.submit("warm", prompts[0], max_new=max_new)
+    warm.run_until_idle()
+
+    _metrics.reset()  # the batched arm's run is the exported evidence
+    batched_wall, batched_streams, ttfts, rounds = drive(batch=True)
+    seq_wall, seq_streams, _, _ = drive(batch=False)
+    for i in range(n_requests):
+        if batched_streams[f"b{i}"] != seq_streams[f"b{i}"]:
+            raise RuntimeError(
+                f"serve_decode bench: request {i} streams diverged "
+                "between batched and sequential arms"
+            )
+
+    tokens = sum(len(s) for s in batched_streams.values())
+    speedup = seq_wall / batched_wall
+    if speedup < 3.0:
+        raise RuntimeError(
+            f"serve_decode bench: batched decode only {speedup:.2f}x "
+            "sequential (acceptance floor is 3x)"
+        )
+    prom = _export.render_prometheus({"driver": _metrics.snapshot()})
+    decode_families = sorted({
+        line.split("{")[0].split(" ")[0]
+        for line in prom.splitlines()
+        if line.startswith("raydp_decode_")
+    })
+    if not decode_families:
+        raise RuntimeError(
+            "serve_decode bench: no raydp_decode_* telemetry exported"
+        )
+    occupancies = [
+        r["live"] / num_slots for r in rounds if r["live"] > 0
+    ]
+    ttft_p99 = ttfts[min(len(ttfts) - 1, int(0.99 * len(ttfts)))]
+    return {
+        "requests": n_requests,
+        "tokens": tokens,
+        "decode_tokens_per_sec": round(tokens / batched_wall, 2),
+        "sequential_tokens_per_sec": round(tokens / seq_wall, 2),
+        "speedup_vs_sequential": round(speedup, 2),
+        "ttft_p50_s": round(ttfts[len(ttfts) // 2], 5),
+        "ttft_p99_s": round(ttft_p99, 5),
+        "rounds": len(rounds),
+        "batch_occupancy_mean": round(
+            sum(occupancies) / max(1, len(occupancies)), 4
+        ),
+        "decode_families_exported": len(decode_families),
+    }
+
+
 def bench_autoscale():
     """Autoscaler evidence (doc/scheduling.md#autoscaling): against a
     real one-worker cluster, sustained admission pressure must grow
@@ -2621,6 +2738,10 @@ CPU_MATRIX = [
     # Load observatory: open-loop knee ramp over the same replica
     # group — max sustainable RPS + phase split (doc/serving.md).
     ("serve_load", bench_serve_load),
+    # Decode plane: paged-KV continuous batching vs one-request-at-a-
+    # time over the same tiny CausalLM — tokens/s, TTFT, occupancy
+    # (doc/serving.md#autoregressive-decode). In-process, CPU-sized.
+    ("serve_decode", bench_serve_decode),
     # Self-sizing pool: time-to-scale-up, graceful-drain latency, and
     # flap count against a real worker pool (doc/scheduling.md).
     ("autoscale", bench_autoscale),

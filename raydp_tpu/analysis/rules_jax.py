@@ -19,6 +19,13 @@ doubles parameter HBM. Checks:
 * ``jit-missing-donation`` (warning) — a ``jax.jit(...)`` whose
   target name contains ``step`` or ``update`` with no
   ``donate_argnums``/``donate_argnames``.
+* ``host-sync-in-decode-loop`` (warning) — per-token ``.item()`` /
+  ``block_until_ready()`` / ``device_get`` inside an autoregressive
+  decode loop (a ``for``/``while`` in a function whose name mentions
+  decode/generate/run_round). Decode rounds must fetch the whole
+  batch's tokens in ONE host sync per round — a per-token sync
+  serializes the round loop exactly like a per-step ``.item()``
+  serializes training, but at token frequency.
 
 Jitted functions are found via decorators (``@jax.jit``, ``@jit``,
 ``@partial(jax.jit, ...)``) and wrapper assignments
@@ -40,6 +47,7 @@ from raydp_tpu.analysis.core import Finding, ModuleInfo, Project
 RULE = "R5"
 
 _LOOPY_FN_HINTS = ("train", "fit", "epoch", "step_loop", "run_steps")
+_DECODE_FN_HINTS = ("decode", "generate", "run_round", "token_loop")
 _PROFILING_HINTS = ("profil", "bench", "timing", "measure", "trace",
                     "warmup")
 _DONATE_TARGET_HINTS = ("step", "update")
@@ -107,6 +115,10 @@ def check(project: Project) -> List[Finding]:
         if any(h in fn.node.name.lower() for h in _LOOPY_FN_HINTS) and \
                 not _profiling_context(fn):
             _scan_step_loops(fn, findings)
+        if any(h in fn.node.name.lower() for h in _DECODE_FN_HINTS) and \
+                not _profiling_context(fn) and \
+                "reference" not in fn.node.name.lower():
+            _scan_decode_loops(fn, findings)
 
     _check_donation(project, graph, findings)
     return findings
@@ -181,6 +193,47 @@ def _scan_step_loops(fn: FunctionInfo, findings: List[Finding]) -> None:
             if msg:
                 findings.append(Finding(
                     rule=RULE, name="host-sync-in-step-loop",
+                    severity="warning",
+                    path=mod.rel, line=node.lineno, col=node.col_offset,
+                    message=msg, scope=fn.qualname,
+                ))
+
+
+def _scan_decode_loops(fn: FunctionInfo, findings: List[Finding]) -> None:
+    """Per-token host syncs inside an autoregressive decode loop.
+
+    Reference implementations are exempt at the call site (a
+    ``reference_*`` decode is *supposed* to be the slow unbatched
+    path); everything else named like a decode/generate loop must
+    batch its token fetch — one sync per round, never one per token
+    or per sequence."""
+    mod = fn.module
+    seen: Set[Tuple[int, int]] = set()
+    for stmt in ast.walk(fn.node):
+        if not isinstance(stmt, (ast.For, ast.While, ast.AsyncFor)):
+            continue
+        for node in _iter_calls(stmt.body):
+            if (node.lineno, node.col_offset) in seen:
+                continue  # nested loops walk the same body twice
+            seen.add((node.lineno, node.col_offset))
+            name = call_name(node.func)
+            last = name.rsplit(".", 1)[-1] if name else ""
+            msg = None
+            if isinstance(node.func, ast.Attribute) and last == "item" \
+                    and not node.args:
+                msg = "`.item()` per token serializes the decode " \
+                      "round; fetch the whole batch's tokens in one " \
+                      "device_get per round"
+            elif last == "block_until_ready":
+                msg = "`block_until_ready()` per token stalls the " \
+                      "decode round loop; the per-round token fetch " \
+                      "is the only sync needed"
+            elif last == "device_get":
+                msg = "`device_get` inside the per-token loop; hoist " \
+                      "it to one batched fetch per decode round"
+            if msg:
+                findings.append(Finding(
+                    rule=RULE, name="host-sync-in-decode-loop",
                     severity="warning",
                     path=mod.rel, line=node.lineno, col=node.col_offset,
                     message=msg, scope=fn.qualname,

@@ -83,6 +83,17 @@ class RequestOutcome:
     deadline_slack_s: Optional[float] = None
     phases: Optional[Dict[str, float]] = None
     request_id: Optional[str] = None
+    # Token-level fields (decode workloads; None for plain predict):
+    ttft_s: Optional[float] = None
+    tokens: Optional[int] = None
+    tokens_requested: Optional[int] = None
+
+    @property
+    def tpot_s(self) -> Optional[float]:
+        """Per-output-token latency after the first token."""
+        if self.ttft_s is None or not self.tokens or self.tokens < 2:
+            return None
+        return max(0.0, self.latency_s - self.ttft_s) / (self.tokens - 1)
 
     def to_record(self) -> Dict[str, Any]:
         return {
@@ -102,6 +113,10 @@ class RequestOutcome:
             ),
             "phases": self.phases,
             "request_id": self.request_id,
+            "ttft_s": (round(self.ttft_s, 6)
+                       if self.ttft_s is not None else None),
+            "tokens": self.tokens,
+            "tokens_requested": self.tokens_requested,
         }
 
 
@@ -110,33 +125,60 @@ class RequestOutcome:
 
 class GroupTarget:
     """Fire into anything with ``submit(payload, timeout_s=...,
-    request_id=...) -> waitable`` — normally a ReplicaGroup."""
+    request_id=...) -> waitable`` — normally a ReplicaGroup.
 
-    def __init__(self, group: Any):
+    With ``decode=True`` the target fires ``submit_generate`` instead
+    (decode-mode groups): the event size becomes the prompt length,
+    ``max_new`` the requested output tokens, and the fire dict carries
+    the token-level fields (``ttft_s``, ``tokens``,
+    ``tokens_requested``) the open-loop wheel threads into each
+    :class:`RequestOutcome`."""
+
+    def __init__(self, group: Any, *, decode: bool = False,
+                 max_new: int = 32, eos: Optional[int] = None):
         self.group = group
+        self.decode = decode
+        self.max_new = max_new
+        self.eos = eos
 
     def fire(self, event: TraceEvent, timeout_s: float) -> Dict[str, Any]:
         try:
-            req = self.group.submit(
-                [1.0] * max(1, event.size), timeout_s=timeout_s
-            )
+            if self.decode:
+                req = self.group.submit_generate(
+                    [(i % 251) + 1 for i in range(max(1, event.size))],
+                    max_new=self.max_new, eos=self.eos,
+                    timeout_s=timeout_s,
+                )
+            else:
+                req = self.group.submit(
+                    [1.0] * max(1, event.size), timeout_s=timeout_s
+                )
         except QueueFullError:
             return {"status": "shed"}
         except Exception as exc:
             return {"status": "error", "error": str(exc)}
+        tokens_requested = self.max_new if self.decode else None
         try:
-            req.wait()
+            result = req.wait()
         except RequestCancelled:
             return {"status": "timeout",
-                    "request_id": getattr(req, "request_id", None)}
+                    "request_id": getattr(req, "request_id", None),
+                    "tokens_requested": tokens_requested}
         except Exception as exc:
             return {"status": "error", "error": str(exc),
-                    "request_id": getattr(req, "request_id", None)}
-        return {
+                    "request_id": getattr(req, "request_id", None),
+                    "tokens_requested": tokens_requested}
+        out = {
             "status": "ok",
             "request_id": getattr(req, "request_id", None),
             "phases": getattr(req, "phases", None),
         }
+        if self.decode:
+            ttft = getattr(req, "ttft_s", lambda: None)()
+            out["ttft_s"] = ttft
+            out["tokens"] = (result or {}).get("n")
+            out["tokens_requested"] = tokens_requested
+        return out
 
 
 class QueueTarget:
@@ -241,6 +283,44 @@ class LoadResult:
         idx = min(len(lats) - 1, int(q * len(lats)))
         return lats[idx]
 
+    def ttft_quantile(self, q: float) -> Optional[float]:
+        """Time-to-first-token quantile over ok decode outcomes."""
+        vals = sorted(
+            o.ttft_s for o in self.outcomes
+            if o.status == "ok" and o.ttft_s is not None
+        )
+        if not vals:
+            return None
+        idx = min(len(vals) - 1, int(q * len(vals)))
+        return vals[idx]
+
+    def tpot_quantile(self, q: float) -> Optional[float]:
+        """Per-output-token latency quantile over ok decode outcomes."""
+        vals = sorted(
+            o.tpot_s for o in self.outcomes
+            if o.status == "ok" and o.tpot_s is not None
+        )
+        if not vals:
+            return None
+        idx = min(len(vals) - 1, int(q * len(vals)))
+        return vals[idx]
+
+    @property
+    def achieved_tokens_per_sec(self) -> float:
+        """Output tokens actually produced per second of schedule."""
+        total = sum(
+            o.tokens or 0 for o in self.outcomes if o.status == "ok"
+        )
+        return total / self.duration_s if self.duration_s > 0 else 0.0
+
+    @property
+    def offered_tokens_per_sec(self) -> float:
+        """Output tokens the schedule *asked* for per second — the
+        decode analogue of offered_rps; achieved/offered below 1.0 is
+        the knee signature for token workloads."""
+        total = sum(o.tokens_requested or 0 for o in self.outcomes)
+        return total / self.duration_s if self.duration_s > 0 else 0.0
+
     def phase_fractions(self) -> Dict[str, float]:
         """Mean fraction of end-to-end wall spent in each phase,
         over requests that carried a decomposition."""
@@ -263,7 +343,7 @@ class LoadResult:
 
     def summary(self) -> Dict[str, Any]:
         counts = self.counts()
-        return {
+        out = {
             "offered_rps": round(self.offered_rps, 3),
             "achieved_rps": round(self.achieved_rps, 3),
             "duration_s": round(self.duration_s, 3),
@@ -280,6 +360,29 @@ class LoadResult:
                 for k, v in self.phase_fractions().items()
             },
         }
+        if any(o.tokens is not None or o.tokens_requested is not None
+               for o in self.outcomes):
+            ttft_p50 = self.ttft_quantile(0.5)
+            ttft_p99 = self.ttft_quantile(0.99)
+            tpot_p50 = self.tpot_quantile(0.5)
+            tpot_p99 = self.tpot_quantile(0.99)
+            out["tokens"] = {
+                "offered_tokens_per_sec": round(
+                    self.offered_tokens_per_sec, 3
+                ),
+                "achieved_tokens_per_sec": round(
+                    self.achieved_tokens_per_sec, 3
+                ),
+                "ttft_p50_s": (round(ttft_p50, 6)
+                               if ttft_p50 is not None else None),
+                "ttft_p99_s": (round(ttft_p99, 6)
+                               if ttft_p99 is not None else None),
+                "tpot_p50_s": (round(tpot_p50, 6)
+                               if tpot_p50 is not None else None),
+                "tpot_p99_s": (round(tpot_p99, 6)
+                               if tpot_p99 is not None else None),
+            }
+        return out
 
 
 # -- the open-loop wheel ------------------------------------------------
@@ -337,6 +440,9 @@ def run_schedule(target: Any, events: Sequence[TraceEvent], *,
             deadline_slack_s=timeout_s - latency,
             phases=phases,
             request_id=raw.get("request_id"),
+            ttft_s=raw.get("ttft_s"),
+            tokens=raw.get("tokens"),
+            tokens_requested=raw.get("tokens_requested"),
         )
 
     t0 = time.monotonic()

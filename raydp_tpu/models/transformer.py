@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import flax.linen as nn
 
 from raydp_tpu.ops.attention import (
+    cached_decode_attention,
     reference_attention,
     ring_attention,
     ulysses_attention,
@@ -88,7 +89,15 @@ class MultiHeadAttention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, deterministic: bool = True):
+    def __call__(
+        self,
+        x,
+        deterministic: bool = True,
+        *,
+        cache_mode: Optional[str] = None,
+        cache_positions=None,
+        kv_len: Optional[int] = None,
+    ):
         cfg = self.cfg
         qkv = nn.DenseGeneral(
             features=(3, cfg.n_heads, cfg.head_dim),
@@ -101,7 +110,55 @@ class MultiHeadAttention(nn.Module):
         )(x)
         q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
 
-        if cfg.attention_impl == "dense":
+        if cache_mode is not None:
+            # Per-slot KV cache rows (serve-plane autoregressive decode).
+            # Row b belongs to whichever request currently owns slot b;
+            # the pool in serve/decode.py recycles rows without zeroing —
+            # masking by cache length in cached_decode_attention is what
+            # keeps stale pages invisible.
+            b = x.shape[0]
+            cache_shape = (b, cfg.max_len, cfg.n_heads, cfg.head_dim)
+            ck = self.variable(
+                "cache", "cached_key",
+                lambda: jnp.zeros(cache_shape, cfg.dtype),
+            )
+            cv = self.variable(
+                "cache", "cached_value",
+                lambda: jnp.zeros(cache_shape, cfg.dtype),
+            )
+            if cache_mode == "prefill":
+                # Whole (padded) prompt lands in rows [0, S); positions
+                # past the true prompt length hold junk until decode
+                # overwrites them one step at a time — always before the
+                # length mask admits them.
+                ck.value = jax.lax.dynamic_update_slice_in_dim(
+                    ck.value, k.astype(cfg.dtype), 0, axis=1
+                )
+                cv.value = jax.lax.dynamic_update_slice_in_dim(
+                    cv.value, v.astype(cfg.dtype), 0, axis=1
+                )
+                out = reference_attention(q, k, v, causal=True)
+            elif cache_mode == "step":
+                # One token per slot: scatter K/V at each slot's current
+                # cache length, then attend over a static kv_len-bucket
+                # slice (static slice = one XLA program per bucket, and
+                # no gather of max_len when the batch is young).
+                rows = jnp.arange(b)
+                ck.value = ck.value.at[rows, cache_positions].set(
+                    k[:, 0].astype(cfg.dtype)
+                )
+                cv.value = cv.value.at[rows, cache_positions].set(
+                    v[:, 0].astype(cfg.dtype)
+                )
+                out = cached_decode_attention(
+                    q,
+                    ck.value[:, :kv_len],
+                    cv.value[:, :kv_len],
+                    cache_positions + 1,
+                )
+            else:
+                raise ValueError(f"unknown cache_mode {cache_mode!r}")
+        elif cfg.attention_impl == "dense":
             out = reference_attention(q, k, v, causal=cfg.causal)
         elif cfg.attention_impl == "ring":
             out = ring_attention(
@@ -143,7 +200,15 @@ class TransformerBlock(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, deterministic: bool = True):
+    def __call__(
+        self,
+        x,
+        deterministic: bool = True,
+        *,
+        cache_mode: Optional[str] = None,
+        cache_positions=None,
+        kv_len: Optional[int] = None,
+    ):
         cfg = self.cfg
         y = nn.LayerNorm(
             dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="ln_attn",
@@ -151,7 +216,13 @@ class TransformerBlock(nn.Module):
                 nn.initializers.ones, ("embed",)
             ),
         )(x)
-        x = x + MultiHeadAttention(cfg, name="attn")(y, deterministic)
+        x = x + MultiHeadAttention(cfg, name="attn")(
+            y,
+            deterministic,
+            cache_mode=cache_mode,
+            cache_positions=cache_positions,
+            kv_len=kv_len,
+        )
 
         y = nn.LayerNorm(
             dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="ln_mlp",
@@ -190,14 +261,28 @@ class TransformerEncoder(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, input_ids, segment_ids=None, deterministic: bool = True):
+    def __call__(
+        self,
+        input_ids,
+        segment_ids=None,
+        deterministic: bool = True,
+        *,
+        cache_mode: Optional[str] = None,
+        cache_positions=None,
+        kv_len: Optional[int] = None,
+    ):
         cfg = self.cfg
         x = nn.Embed(
             cfg.vocab_size, cfg.d_model,
             embedding_init=_embed_init("vocab", "embed"),
             dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="tok_embed",
         )(input_ids)
-        pos = jnp.arange(input_ids.shape[-1])[None, :]
+        if cache_mode == "step":
+            # Each slot's token sits at its own absolute position — the
+            # slot's current cache length, not a shared arange.
+            pos = jnp.minimum(cache_positions, cfg.max_len - 1)[:, None]
+        else:
+            pos = jnp.arange(input_ids.shape[-1])[None, :]
         x = x + nn.Embed(
             cfg.max_len, cfg.d_model,
             embedding_init=_embed_init("seq", "embed"),
@@ -216,13 +301,21 @@ class TransformerEncoder(nn.Module):
         # remat: recompute block activations in the backward instead of
         # storing them — the standard FLOPs-for-HBM trade that unlocks
         # bigger batches/sequences when training is memory-bound.
+        if cache_mode is not None and cfg.remat:
+            raise ValueError("decode cache is incompatible with remat")
         block_cls = (
             nn.remat(TransformerBlock, static_argnums=(2,))
             if cfg.remat
             else TransformerBlock
         )
         for i in range(cfg.n_layers):
-            x = block_cls(cfg, name=f"block_{i}")(x, deterministic)
+            x = block_cls(cfg, name=f"block_{i}")(
+                x,
+                deterministic,
+                cache_mode=cache_mode,
+                cache_positions=cache_positions,
+                kv_len=kv_len,
+            )
         return nn.LayerNorm(
             dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="ln_final",
             scale_init=nn.with_logical_partitioning(
@@ -265,24 +358,89 @@ class SequenceClassifier(nn.Module):
 
 class CausalLM(nn.Module):
     """Decoder-only LM: the long-context flagship — pair with
-    ``attention_impl='ring'`` to scale sequence length over the sp axis."""
+    ``attention_impl='ring'`` to scale sequence length over the sp axis.
+
+    Besides the teacher-forced ``__call__``, exposes the serve-plane
+    decode pair: :meth:`prefill` runs the prompt once, writing per-slot
+    KV-cache rows (flax ``"cache"`` collection) and returning the first
+    greedy token's logits; :meth:`decode_step` extends every live slot by
+    one token against that cache. The round loop in serve/decode.py jits
+    both with the cache buffers donated, so steady-state decode never
+    reallocates HBM.
+    """
 
     cfg: TransformerConfig
 
-    @nn.compact
-    def __call__(self, input_ids, deterministic: bool = True):
-        cfg = self.cfg
-        assert cfg.causal, "CausalLM requires cfg.causal=True"
-        h = TransformerEncoder(cfg, name="encoder")(
-            input_ids, None, deterministic
-        )
-        return nn.Dense(
-            cfg.vocab_size,
+    def setup(self):
+        assert self.cfg.causal, "CausalLM requires cfg.causal=True"
+        # Attribute names double as scope names, keeping the param tree
+        # ("encoder", "lm_head") identical to the old nn.compact layout.
+        self.encoder = TransformerEncoder(self.cfg)
+        self.lm_head = nn.Dense(
+            self.cfg.vocab_size,
             kernel_init=_dense_init("embed", "vocab"),
             dtype=jnp.float32,
-            param_dtype=cfg.param_dtype,
-            name="lm_head",
-        )(h)
+            param_dtype=self.cfg.param_dtype,
+        )
+
+    def __call__(self, input_ids, deterministic: bool = True):
+        h = self.encoder(input_ids, None, deterministic)
+        return self.lm_head(h)
+
+    def prefill(self, input_ids, lengths):
+        """Prompt pass that populates the KV cache.
+
+        ``input_ids`` [B, S] right-padded prompts, ``lengths`` [B] true
+        prompt lengths. Apply with ``mutable=["cache"]`` to receive the
+        freshly written cache rows. Returns logits at each prompt's last
+        real position — argmax of which is the sequence's first generated
+        token (so TTFT costs exactly one forward pass).
+        """
+        h = self.encoder(input_ids, None, True, cache_mode="prefill")
+        last = jnp.take_along_axis(
+            h, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1
+        )
+        return self.lm_head(last)[:, 0]
+
+    def decode_step(self, tokens, cache_positions, kv_len: int):
+        """One decode iteration over the whole slot batch.
+
+        ``tokens`` [B, 1] last generated token per slot, ``cache_positions``
+        [B] current cache length per slot (the position the new token is
+        written to), ``kv_len`` static cache-length bucket. Apply with the
+        ``"cache"`` collection mutable; returns next-token logits [B, V].
+        """
+        h = self.encoder(
+            tokens,
+            None,
+            True,
+            cache_mode="step",
+            cache_positions=cache_positions,
+            kv_len=kv_len,
+        )
+        return self.lm_head(h)[:, 0]
+
+    def init_cache(self, batch: int):
+        """Shape-only helper: an all-zeros cache pytree for ``batch``
+        slots (what one jitted prefill would create, without running it)."""
+        cfg = self.cfg
+        shape = (batch, cfg.max_len, cfg.n_heads, cfg.head_dim)
+
+        def zeros(_):
+            return jnp.zeros(shape, cfg.dtype)
+
+        names = [f"block_{i}" for i in range(cfg.n_layers)]
+        return {
+            "encoder": {
+                name: {
+                    "attn": {
+                        "cached_key": zeros(None),
+                        "cached_value": zeros(None),
+                    }
+                }
+                for name in names
+            }
+        }
 
 
 # ---------------------------------------------------------------- factories

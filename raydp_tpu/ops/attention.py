@@ -46,6 +46,30 @@ def reference_attention(
 NEG_INF = -1e30
 
 
+def cached_decode_attention(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    lengths: jnp.ndarray,
+) -> jnp.ndarray:
+    """Single-step decode attention over a per-slot KV cache.
+
+    ``q`` is one new query per slot — shape [B, 1, H, D] — attending over
+    the first ``lengths[b]`` positions of its cache row ([B, T, H, D]).
+    Positions at and beyond ``lengths[b]`` are masked, so stale pages from
+    a previous occupant of the slot can never leak into a live sequence.
+    T is the *cache-length bucket* chosen by the round loop, not the
+    model's max_len — slicing the cache before calling keeps the score
+    matrix O(B·T) per step.
+    """
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache) * scale
+    valid = jnp.arange(k_cache.shape[1])[None, :] < lengths[:, None]  # [B, T]
+    scores = jnp.where(valid[:, None, None, :], scores, NEG_INF)
+    weights = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v_cache)
+
+
 def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
     """Per-device ring step. q/k/v local: [B, S_l, H, D]."""
     n = jax.lax.axis_size(axis_name)

@@ -16,8 +16,15 @@ requests onto a surviving replica (zero dropped requests); the
 replied-flag dedup keeps delivery at-most-once when a late reply races
 the retry; overload degrades to 429 + Retry-After instead of silent
 loss.
+
+Autoregressive decode (``mode="decode"``) extends the same contract to
+token granularity: a paged KV-cache slot pool plus a continuous-
+batching round loop (:mod:`raydp_tpu.serve.decode`), with a killed
+replica's in-flight sequences re-entering the queue as prefills and
+token-index dedup keeping streams at-most-once.
 """
 from raydp_tpu.serve.batching import (
+    DecodeState,
     QueueFullError,
     RequestCancelled,
     RequestQueue,
@@ -27,6 +34,20 @@ from raydp_tpu.serve.batching import (
     SERVE_SLO_MS_ENV,
     SERVE_TIMEOUT_ENV,
     ServeRequest,
+)
+from raydp_tpu.serve.decode import (
+    DECODE_MAX_NEW_ENV,
+    DECODE_PAGE_TOKENS_ENV,
+    DECODE_PAGES_ENV,
+    DECODE_ROUND_LINGER_ENV,
+    DECODE_SLOTS_ENV,
+    DecodeConfig,
+    DecodeLoop,
+    PagedSlotPool,
+    ToyDecodeEngine,
+    TransformerDecodeEngine,
+    build_transformer_engine,
+    reference_decode,
 )
 from raydp_tpu.serve.frontend import SERVE_PORT_ENV, ServeFrontend
 from raydp_tpu.serve.group import (
@@ -40,6 +61,15 @@ from raydp_tpu.serve.group import (
 from raydp_tpu.serve.replica_main import default_model
 
 __all__ = [
+    "DECODE_MAX_NEW_ENV",
+    "DECODE_PAGES_ENV",
+    "DECODE_PAGE_TOKENS_ENV",
+    "DECODE_ROUND_LINGER_ENV",
+    "DECODE_SLOTS_ENV",
+    "DecodeConfig",
+    "DecodeLoop",
+    "DecodeState",
+    "PagedSlotPool",
     "QueueFullError",
     "ReplicaGroup",
     "RequestCancelled",
@@ -57,5 +87,9 @@ __all__ = [
     "ServeError",
     "ServeFrontend",
     "ServeRequest",
+    "ToyDecodeEngine",
+    "TransformerDecodeEngine",
+    "build_transformer_engine",
     "default_model",
+    "reference_decode",
 ]

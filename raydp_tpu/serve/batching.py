@@ -87,6 +87,31 @@ class RequestCancelled(RuntimeError):
     replica produced its reply."""
 
 
+class DecodeState:
+    """Driver-side truth for one autoregressive request.
+
+    ``tokens`` is the only copy of the generated stream that survives
+    replica death — a requeued sequence re-feeds ``prompt + tokens`` as
+    its next incarnation's prefill, and token events are deduplicated
+    against ``len(tokens)`` by global index (the token-level half of
+    the at-most-once contract).
+    """
+
+    __slots__ = (
+        "prompt", "max_new", "eos", "tokens", "first_token_mono",
+        "finish_reason",
+    )
+
+    def __init__(self, prompt: Sequence[int], max_new: int,
+                 eos: Optional[int] = None):
+        self.prompt = [int(t) for t in prompt]
+        self.max_new = int(max_new)
+        self.eos = eos
+        self.tokens: List[int] = []
+        self.first_token_mono: Optional[float] = None
+        self.finish_reason: Optional[str] = None
+
+
 class ServeRequest:
     """One accepted request, tracked from admission until its single
     reply is delivered."""
@@ -95,11 +120,12 @@ class ServeRequest:
         "request_id", "payload", "length", "enqueued_mono",
         "deadline_mono", "attempts", "done", "result", "error",
         "replied", "cancelled", "dequeued_mono", "dispatched_mono",
-        "exec_s", "bucket", "phases",
+        "exec_s", "bucket", "phases", "decode",
     )
 
     def __init__(self, payload: Any, timeout_s: Optional[float] = None,
-                 request_id: Optional[str] = None):
+                 request_id: Optional[str] = None,
+                 decode: Optional[DecodeState] = None):
         self.request_id = request_id or uuid.uuid4().hex
         self.payload = payload
         try:
@@ -123,6 +149,15 @@ class ServeRequest:
         self.exec_s: Optional[float] = None
         self.bucket: Optional[int] = None
         self.phases: Optional[dict] = None
+        # Autoregressive requests carry a DecodeState; plain predict
+        # requests leave this None and nothing downstream changes.
+        self.decode = decode
+
+    def ttft_s(self) -> Optional[float]:
+        """Time to first token (decode requests only)."""
+        if self.decode is None or self.decode.first_token_mono is None:
+            return None
+        return max(0.0, self.decode.first_token_mono - self.enqueued_mono)
 
     def remaining_s(self, now: Optional[float] = None) -> float:
         return self.deadline_mono - (now if now is not None
@@ -158,6 +193,12 @@ PHASE_NAMES = ("queue_wait", "linger", "execute", "reply")
 #: ``padding_waste`` sub-slice of ``execute`` (not part of the sum).
 PHASE_LABELS = PHASE_NAMES + ("padding_waste",)
 
+#: Decode-only sub-slices of ``execute``: ``prefill`` (dispatch → first
+#: token) and ``decode`` (first token → completion). Like
+#: ``padding_waste`` they are informational — already counted inside
+#: ``execute``, so the four-phase sum contract is untouched.
+DECODE_PHASE_LABELS = ("prefill", "decode")
+
 
 def request_phases(req: ServeRequest,
                    completed_mono: float) -> Optional[dict]:
@@ -191,7 +232,7 @@ def request_phases(req: ServeRequest,
     if req.bucket and req.bucket > 0:
         fill = min(1.0, max(0.0, req.length / req.bucket))
         waste = execute * (1.0 - fill)
-    return {
+    out = {
         "queue_wait": queue_wait,
         "linger": linger,
         "execute": execute,
@@ -199,6 +240,15 @@ def request_phases(req: ServeRequest,
         "padding_waste": waste,
         "total": total,
     }
+    if req.decode is not None and req.decode.first_token_mono is not None:
+        # TTFT/TPOT provenance by construction: execute splits at the
+        # first token's arrival. prefill+decode == execute exactly.
+        prefill = min(
+            execute, max(0.0, req.decode.first_token_mono - dispatched)
+        )
+        out["prefill"] = prefill
+        out["decode"] = execute - prefill
+    return out
 
 
 class RequestQueue:
@@ -444,6 +494,11 @@ class RequestQueue:
                 metrics.histogram(f"serve/phase/{name}").observe(
                     phases[name]
                 )
+            for name in DECODE_PHASE_LABELS:
+                if name in phases:
+                    metrics.histogram(f"serve/phase/{name}").observe(
+                        phases[name]
+                    )
         req.done.set()
         return True
 

@@ -142,6 +142,68 @@ class ServeFrontend:
                 corr,
             )
 
+    def handle_generate(self, body: Dict[str, Any],
+                        headers: Optional[Dict[str, str]] = None) -> tuple:
+        """Process one /generate body (decode-mode groups): ``prompt``
+        is a token list, optional ``max_new``/``eos``/``timeout_s``.
+        Same degradation contract as /predict; 200 bodies add the
+        token stream and its TTFT."""
+        from raydp_tpu.control import ClusterBusyError
+
+        prompt = body.get("prompt")
+        if not isinstance(prompt, (list, tuple)) or not prompt:
+            return 400, {"error": "body must carry a non-empty 'prompt' "
+                                  "token list"}, {}
+        submit = getattr(self.group, "submit_generate", None)
+        if submit is None:
+            return 400, {"error": "group does not support generate "
+                                  "(mode='decode' required)"}, {}
+        t0 = time.monotonic()
+        try:
+            req = submit(
+                prompt,
+                max_new=int(body.get("max_new") or 32),
+                eos=body.get("eos"),
+                timeout_s=body.get("timeout_s"),
+                request_id=body.get("id"),
+            )
+        except (QueueFullError, ClusterBusyError) as exc:
+            return (
+                429,
+                {
+                    "error": str(exc),
+                    "queue_depth": getattr(exc, "queue_depth", 0),
+                    "eta_s": getattr(exc, "eta_s", None),
+                },
+                {"Retry-After": str(retry_after_s(exc))},
+            )
+        corr = {"X-RayDP-Request-Id": req.request_id}
+        try:
+            result = req.wait()
+        except RequestCancelled as exc:
+            return 504, {"error": str(exc), "id": req.request_id}, corr
+        except Exception as exc:
+            return 500, {"error": str(exc), "id": req.request_id}, corr
+        phases = req.phases
+        ttft = req.ttft_s()
+        return (
+            200,
+            {
+                "id": req.request_id,
+                "tokens": result.get("tokens"),
+                "n": result.get("n"),
+                "finish_reason": result.get("finish_reason"),
+                "ttft_s": round(ttft, 6) if ttft is not None else None,
+                "latency_s": round(time.monotonic() - t0, 6),
+                "attempts": req.attempts,
+                "phases": (
+                    {k: round(v, 6) for k, v in phases.items()}
+                    if phases else None
+                ),
+            },
+            corr,
+        )
+
     # -- HTTP plumbing ---------------------------------------------------
 
     def start(self, port: Optional[int] = None,
@@ -182,7 +244,8 @@ class ServeFrontend:
             def do_POST(self):  # noqa: N802 - http.server API
                 from urllib.parse import urlsplit
 
-                if urlsplit(self.path).path != "/predict":
+                route = urlsplit(self.path).path
+                if route not in ("/predict", "/generate"):
                     self.send_error(404)
                     return
                 try:
@@ -193,8 +256,11 @@ class ServeFrontend:
                 except (ValueError, UnicodeDecodeError):
                     self._reply_json(400, {"error": "invalid JSON body"})
                     return
+                handle = (frontend.handle_generate
+                          if route == "/generate"
+                          else frontend.handle_predict)
                 try:
-                    code, payload, headers = frontend.handle_predict(
+                    code, payload, headers = handle(
                         body, headers=dict(self.headers.items())
                     )
                     self._reply_json(code, payload, headers)

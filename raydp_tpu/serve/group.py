@@ -35,6 +35,7 @@ import cloudpickle
 
 from raydp_tpu.cluster.rpc import RpcClient, RpcServer
 from raydp_tpu.serve.batching import (
+    DecodeState,
     PHASE_LABELS,
     RequestQueue,
     ServeRequest,
@@ -44,6 +45,7 @@ from raydp_tpu.serve.batching import (
 from raydp_tpu.serve.replica_main import (
     ENV_GROUP,
     ENV_INCARNATION,
+    ENV_MODE,
     ENV_REPLICA,
     ENV_SERVE_DRIVER_ADDR,
     REPLICA_SERVICE,
@@ -154,6 +156,7 @@ class _ReplicaSlot:
                 ENV_REPLICA: str(self.index),
                 ENV_INCARNATION: str(self.restarts),
                 ENV_GROUP: g.label,
+                ENV_MODE: g.mode,
                 ENV_SERVE_DRIVER_ADDR: g._driver_addr,
                 **_acct.env_for_child(g._job_ctx),
             }
@@ -191,6 +194,15 @@ class _ReplicaSlot:
         """Pull batches and ship them until the replica dies or the
         group stops. Every failure path requeues the batch."""
         g = self.group
+        if g.mode == "decode":
+            try:
+                self._dispatch_decode(stub)
+            finally:
+                # Replica gone (or group stopping): every sequence this
+                # lineage still owns re-enters the queue as a prefill —
+                # cache is lost, the generated-so-far prefix is re-fed.
+                g._decode_requeue_for_slot(self.index)
+            return
         while not g._stopping.is_set():
             if self.proc is not None and self.proc.poll() is not None:
                 return
@@ -252,6 +264,92 @@ class _ReplicaSlot:
                     req, error="replica returned short batch"
                 )
 
+    def _dispatch_decode(self, stub: RpcClient) -> None:
+        """Admission pump for one decode replica: pull arrivals from
+        the shared queue, ship them as ``AdmitSequences``, and requeue
+        whatever the replica's slot pool cannot take. Token traffic
+        flows the other way — the replica pushes ``DecodeEvents`` to
+        the driver once per round."""
+        g = self.group
+        while not g._stopping.is_set():
+            if self.proc is not None and self.proc.poll() is not None:
+                return
+            batch = g.queue.next_batch(wait_timeout=0.25)
+            if not batch:
+                continue
+            now = time.monotonic()
+            admitted: List[ServeRequest] = []
+            payload = []
+            for r in batch:
+                if r.decode is None:
+                    g.queue.complete(
+                        r, error="decode group received a non-decode "
+                                 "request (use generate())",
+                    )
+                    continue
+                r.dispatched_mono = now
+                st = r.decode
+                # Refeed contract: an earlier incarnation's tokens ride
+                # along in the prompt; start_index keeps the global
+                # token indices (and so the dedup) contiguous.
+                payload.append(
+                    {
+                        "id": r.request_id,
+                        "tokens": st.prompt + st.tokens,
+                        "start_index": len(st.tokens),
+                        "max_new": st.max_new,
+                        "eos": st.eos,
+                        "deadline_s": max(0.05, r.remaining_s(now)),
+                    }
+                )
+                admitted.append(r)
+            if not admitted:
+                continue
+            try:
+                reply = stub.call(
+                    "AdmitSequences", {"requests": payload},
+                    timeout=g.dispatch_timeout_s,
+                )
+            except Exception:
+                g.queue.requeue(admitted)
+                _events.emit(
+                    "serve/requeue", group=g.label, replica=self.index,
+                    reason="admit_failed",
+                    request_ids=[r.request_id for r in admitted],
+                )
+                return
+            if reply.get("draining"):
+                g.queue.requeue(admitted)
+                _events.emit(
+                    "serve/requeue", group=g.label, replica=self.index,
+                    reason="draining",
+                    request_ids=[r.request_id for r in admitted],
+                )
+                self._await_exit()
+                return
+            if reply.get("error"):
+                # A replica that cannot admit at all (wrong mode, bad
+                # engine) would spin the requeue cycle forever — treat
+                # it as dead and let supervision decide.
+                logger.error(
+                    "serve slot %d: admit error: %s",
+                    self.index, reply["error"],
+                )
+                g.queue.requeue(admitted)
+                return
+            accepted = set(reply.get("accepted") or ())
+            rejected = [
+                r for r in admitted if r.request_id not in accepted
+            ]
+            for r in admitted:
+                if r.request_id in accepted:
+                    g._decode_track(r, self.index)
+            if rejected:
+                g.queue.requeue(rejected)
+                # A full slot pool rejects everything; don't spin the
+                # admit/requeue cycle against it.
+                time.sleep(0.02)
+
     def _await_exit(self) -> None:
         if self.proc is None:
             return
@@ -277,7 +375,11 @@ class ReplicaGroup:
         max_restarts: Optional[int] = None,
         restart_backoff_s: Optional[float] = None,
         dispatch_timeout_s: Optional[float] = None,
+        mode: str = "batch",
     ):
+        if mode not in ("batch", "decode"):
+            raise ValueError(f"unknown serve mode {mode!r}")
+        self.mode = mode
         self.replicas = (
             _env_int(SERVE_REPLICAS_ENV, _DEFAULT_REPLICAS)
             if replicas is None else int(replicas)
@@ -311,6 +413,10 @@ class ReplicaGroup:
         self._owns_job_ctx = False
         self._sched_lease = None
         self._model_blob: Optional[bytes] = None
+        # Decode mode: driver-side truth for in-flight sequences —
+        # request_id → (ServeRequest, owning slot index).
+        self._decode_mu = threading.Lock()
+        self._decode_inflight: Dict[str, Any] = {}
 
     # -- lifecycle ------------------------------------------------------
 
@@ -341,6 +447,7 @@ class ReplicaGroup:
             SERVE_DRIVER_SERVICE,
             {
                 "RegisterReplica": self._on_register_replica,
+                "DecodeEvents": self._on_decode_events,
                 "Ping": lambda req: {"pong": True},
             },
         )
@@ -373,6 +480,101 @@ class ReplicaGroup:
             "buckets": list(self.queue.buckets),
         }
 
+    # -- decode token plane (driver RPC thread) -------------------------
+
+    def _decode_track(self, req: ServeRequest, slot: int) -> None:
+        with self._decode_mu:
+            self._decode_inflight[req.request_id] = (req, slot)
+
+    def _decode_requeue_for_slot(self, slot: int) -> None:
+        """A dead replica's live sequences re-enter the queue as
+        prefills. Generated-so-far tokens live driver-side, so nothing
+        is lost with the cache; the queue's front-requeue + replied
+        dedup keep the zero-drop / at-most-once contract intact."""
+        with self._decode_mu:
+            mine = [
+                rid for rid, (_, s) in self._decode_inflight.items()
+                if s == slot
+            ]
+            reqs = [self._decode_inflight.pop(rid)[0] for rid in mine]
+        if not reqs:
+            return
+        metrics.counter_add("decode/requeued_prefills", len(reqs))
+        n = self.queue.requeue(reqs)
+        _events.emit(
+            "serve/requeue", group=self.label, replica=slot,
+            reason="decode_replica_death",
+            request_ids=[r.request_id for r in reqs], requeued=n,
+        )
+
+    def _on_decode_events(self, msg: dict) -> dict:
+        """Apply one replica round's token/done events. Tokens append
+        only when their global index equals the driver-side stream
+        length — a late or replayed event from a presumed-dead replica
+        is counted (``decode/dup_tokens``) and dropped."""
+        now = time.monotonic()
+        for ev in msg.get("tokens") or ():
+            with self._decode_mu:
+                entry = self._decode_inflight.get(ev["id"])
+            if entry is None:
+                metrics.counter_add("decode/dup_tokens")
+                continue
+            req = entry[0]
+            st = req.decode
+            idx = int(ev["index"])
+            if idx == len(st.tokens):
+                st.tokens.append(int(ev["token"]))
+                if st.first_token_mono is None:
+                    st.first_token_mono = now
+                    metrics.histogram("decode/ttft").observe(
+                        now - req.enqueued_mono
+                    )
+                metrics.counter_add("decode/tokens")
+                metrics.meter("decode/throughput").add(1)
+            else:
+                metrics.counter_add("decode/dup_tokens")
+        for d in msg.get("done") or ():
+            with self._decode_mu:
+                entry = self._decode_inflight.pop(d["id"], None)
+            if entry is None:
+                continue
+            req = entry[0]
+            st = req.decode
+            reason = d.get("reason")
+            if reason == "evict":
+                # Recompute-preemption: back to the queue head as a
+                # prefill; tokens so far stay with the request.
+                metrics.counter_add("decode/evictions")
+                self.queue.requeue([req])
+                continue
+            metrics.counter_add(f"decode/retired/{reason}")
+            if reason in ("eos", "length"):
+                st.finish_reason = reason
+                n = len(st.tokens)
+                if n > 1 and st.first_token_mono is not None:
+                    metrics.histogram("decode/tpot").observe(
+                        (now - st.first_token_mono) / (n - 1)
+                    )
+                self.queue.complete(
+                    req,
+                    result={
+                        "tokens": list(st.tokens),
+                        "n": n,
+                        "finish_reason": reason,
+                    },
+                )
+            elif reason == "timeout":
+                self.queue.complete(
+                    req,
+                    error=f"request {req.request_id} deadline expired "
+                          "mid-decode",
+                )
+            else:
+                self.queue.complete(
+                    req, error=f"decode retired with reason {reason!r}"
+                )
+        return {"ok": True}
+
     def _on_preempt(self) -> None:
         """Arbiter victim teardown: the whole group drains — replicas
         finish their in-flight batches and the queue stops admitting."""
@@ -402,6 +604,39 @@ class ReplicaGroup:
     def predict(self, payload: Any,
                 timeout_s: Optional[float] = None) -> Any:
         return self.submit(payload, timeout_s=timeout_s).wait()
+
+    def submit_generate(
+        self,
+        prompt: Any,
+        max_new: int = 32,
+        eos: Optional[int] = None,
+        timeout_s: Optional[float] = None,
+        request_id: Optional[str] = None,
+    ) -> ServeRequest:
+        """Admit one autoregressive request (decode mode). The request
+        queues by prompt length; its reply is the assembled token
+        stream ``{"tokens", "n", "finish_reason"}``."""
+        if self.mode != "decode":
+            raise ServeError(
+                f"group {self.label} is mode={self.mode!r}; "
+                "generate() needs mode='decode'"
+            )
+        if not self._started:
+            raise ServeError(f"replica group {self.label} not started")
+        prompt = [int(t) for t in prompt]
+        req = ServeRequest(
+            prompt, timeout_s=timeout_s, request_id=request_id,
+            decode=DecodeState(prompt, max_new, eos=eos),
+        )
+        self.queue.submit(req)
+        return req
+
+    def generate(self, prompt: Any, max_new: int = 32,
+                 eos: Optional[int] = None,
+                 timeout_s: Optional[float] = None) -> Any:
+        return self.submit_generate(
+            prompt, max_new=max_new, eos=eos, timeout_s=timeout_s
+        ).wait()
 
     # -- introspection --------------------------------------------------
 
@@ -445,8 +680,36 @@ class ReplicaGroup:
                 ),
                 "p99_s": ph.quantile(0.99),
             }
+        decode = None
+        if self.mode == "decode":
+            ttft = metrics.histogram("decode/ttft")
+            tpot = metrics.histogram("decode/tpot")
+            tok_rate = metrics.meter("decode/throughput").summary()
+            with self._decode_mu:
+                inflight = len(self._decode_inflight)
+            decode = {
+                "tokens": snap.get("decode/tokens", 0.0),
+                "tokens_per_sec": round(tok_rate["per_sec"], 3),
+                "ttft_p50_s": ttft.quantile(0.5),
+                "ttft_p99_s": ttft.quantile(0.99),
+                "tpot_p50_s": tpot.quantile(0.5),
+                "tpot_p99_s": tpot.quantile(0.99),
+                "inflight": inflight,
+                "dup_tokens": snap.get("decode/dup_tokens", 0.0),
+                "evictions": snap.get("decode/evictions", 0.0),
+                "requeued_prefills": snap.get(
+                    "decode/requeued_prefills", 0.0
+                ),
+                "retired": {
+                    reason: snap.get(f"decode/retired/{reason}", 0.0)
+                    for reason in
+                    ("eos", "length", "timeout", "cancel", "evict")
+                },
+            }
         return {
             "group": self.label,
+            "mode": self.mode,
+            "decode": decode,
             "replicas": self.replicas,
             "replicas_alive": sum(1 for s in self._slots if s.alive),
             "dead_lineages": sum(

@@ -35,6 +35,7 @@ logger = logging.getLogger(__name__)
 ENV_REPLICA = "RAYDP_SERVE_REPLICA"
 ENV_INCARNATION = "RAYDP_SERVE_INCARNATION"
 ENV_GROUP = "RAYDP_SERVE_GROUP"
+ENV_MODE = "RAYDP_SERVE_MODE"
 ENV_SERVE_DRIVER_ADDR = "RAYDP_TPU_SERVE_DRIVER_ADDR"
 
 SERVE_DRIVER_SERVICE = "raydp.ServeDriver"
@@ -63,10 +64,11 @@ class ServeReplica:
     """RPC surface + drain discipline of one replica process."""
 
     def __init__(self, replica: int, incarnation: int, group: str,
-                 driver_addr: str):
+                 driver_addr: str, mode: str = "batch"):
         self.replica = replica
         self.incarnation = incarnation
         self.group = group
+        self.mode = mode
         self.driver = RpcClient(driver_addr, SERVE_DRIVER_SERVICE)
         self.model: Callable[[List[Any], int], List[Any]] = default_model
         self._stop = threading.Event()
@@ -75,10 +77,12 @@ class ServeReplica:
         self._request_seq = 0
         self._busy = 0
         self._mu = threading.Lock()
+        self._decode_loop = None  # built after registration (decode mode)
         self._server = RpcServer(
             REPLICA_SERVICE,
             {
                 "ExecuteBatch": self._on_execute_batch,
+                "AdmitSequences": self._on_admit_sequences,
                 "Ping": lambda req: {"pong": True, "replica": self.replica},
                 "Stop": self._on_stop,
             },
@@ -100,6 +104,16 @@ class ServeReplica:
         blob = reply.get("model")
         if blob is not None:
             self.model = cloudpickle.loads(blob)
+        if self.mode == "decode":
+            # In decode mode the model blob is an *engine factory*
+            # (zero-arg callable → prefill/step engine). Built here so
+            # jit warm-up happens before the first admission.
+            from raydp_tpu.serve.decode import DecodeLoop, ToyDecodeEngine
+
+            engine = self.model() if blob is not None else ToyDecodeEngine()
+            self._decode_loop = DecodeLoop(
+                engine, auto_requeue_evicted=False
+            )
 
     def _on_stop(self, req: dict) -> dict:
         self._stop.set()
@@ -144,6 +158,91 @@ class ServeReplica:
         finally:
             with self._mu:
                 self._busy -= 1
+
+    def _on_admit_sequences(self, req: dict) -> dict:
+        """Decode-mode admission: each request claims a KV slot at the
+        next round. Over-capacity requests are rejected (not queued) so
+        the driver can route them to a sibling replica; refused outright
+        while draining."""
+        if self._decode_loop is None:
+            if self.mode == "decode":
+                # Registration replied but the engine factory is still
+                # building (jit warm-up can take seconds for a real
+                # model): admit nothing so the driver requeues and
+                # retries, instead of declaring the lineage dead.
+                return {"accepted": [], "replica": self.replica}
+            return {"error": "replica is not in decode mode"}
+        if _fault.preemption_requested():
+            return {"draining": True}
+        requests = req.get("requests") or []
+        with self._mu:
+            first = self._request_seq
+            self._request_seq += len(requests)
+        accepted: List[str] = []
+        capacity = self._decode_loop.free_capacity()
+        for offset, r in enumerate(requests):
+            # Fault hooks fire per admission: a serve_kill clause kills
+            # this process while earlier admissions are mid-decode —
+            # their sequences requeue driver-side as prefills.
+            _fault.on_serve_request(first + offset, replica=self.replica)
+            if len(accepted) >= max(0, capacity):
+                continue
+            try:
+                self._decode_loop.submit(
+                    request_id=r["id"],
+                    prompt=r["tokens"],
+                    max_new=r.get("max_new"),
+                    eos=r.get("eos"),
+                    start_index=int(r.get("start_index") or 0),
+                    deadline_s=r.get("deadline_s"),
+                )
+            except ValueError as exc:
+                return_err = str(exc)
+                accepted.append(r["id"])  # claimed, but dies immediately
+                self._decode_loop.cancel(r["id"])
+                logger.warning(
+                    "replica %d: rejecting sequence %s: %s",
+                    self.replica, r["id"], return_err,
+                )
+                continue
+            accepted.append(r["id"])
+        return {"accepted": accepted, "replica": self.replica}
+
+    def _decode_rounds(self) -> None:
+        """The decode round loop: one scheduler iteration, then one
+        event RPC back to the driver — token streaming is per-round,
+        not per-token, so RPC overhead amortizes over the batch."""
+        loop = self._decode_loop
+        linger = loop.config.round_linger_s
+        while not self._stop.is_set():
+            if _fault.preemption_requested():
+                # Abandon in-flight sequences: the driver requeues them
+                # as prefills on a surviving replica when this process
+                # exits — recompute is the drain for decode.
+                _fault.mark_drained()
+                _events.emit(
+                    "serve/drain", replica=self.replica, group=self.group
+                )
+                self._stop.set()
+                return
+            try:
+                stats = loop.run_round()
+            except Exception:
+                logger.exception(
+                    "replica %d: decode round failed; exiting",
+                    self.replica,
+                )
+                self._stop.set()
+                return
+            events = loop.drain_events()
+            if events["tokens"] or events["done"]:
+                self.driver.try_call(
+                    "DecodeEvents",
+                    {"replica": self.replica, **events},
+                    timeout=5.0,
+                )
+            if stats["live"] == 0 and stats["pending"] == 0:
+                time.sleep(linger)
 
     # -- background loops ----------------------------------------------
 
@@ -193,8 +292,15 @@ class ServeReplica:
         self.register()
         threads = [
             threading.Thread(target=self._heartbeat, daemon=True),
-            threading.Thread(target=self._drain_watch, daemon=True),
         ]
+        if self.mode == "decode":
+            threads.append(
+                threading.Thread(target=self._decode_rounds, daemon=True)
+            )
+        else:
+            threads.append(
+                threading.Thread(target=self._drain_watch, daemon=True)
+            )
         for t in threads:
             t.start()
         self._stop.wait()
@@ -216,6 +322,7 @@ def main() -> None:
         incarnation=int(os.environ.get(ENV_INCARNATION, "0")),
         group=os.environ.get(ENV_GROUP, "serve"),
         driver_addr=os.environ[ENV_SERVE_DRIVER_ADDR],
+        mode=os.environ.get(ENV_MODE, "batch"),
     )
     replica.run()
 

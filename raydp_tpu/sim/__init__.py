@@ -29,7 +29,12 @@ Layout:
   sweeps; ``python -m raydp_tpu.sim`` is the CLI.
 """
 from raydp_tpu.sim.vclock import SimClock, SimDeadlockError, SimWallBudgetError
-from raydp_tpu.sim.cluster import ReplicaPool, ServiceModel, SimProvisioner
+from raydp_tpu.sim.cluster import (
+    DecodeServiceModel,
+    ReplicaPool,
+    ServiceModel,
+    SimProvisioner,
+)
 from raydp_tpu.sim.monitors import InvariantMonitor, InvariantViolation
 from raydp_tpu.sim.pathology import Pathology, scan_timeline
 from raydp_tpu.sim.scenario import (
@@ -46,6 +51,7 @@ __all__ = [
     "SimWallBudgetError",
     "SimProvisioner",
     "ReplicaPool",
+    "DecodeServiceModel",
     "ServiceModel",
     "InvariantMonitor",
     "InvariantViolation",

@@ -76,6 +76,36 @@ class ServiceModel:
         return self.base_s + self.per_item_s * batch_len
 
 
+class DecodeServiceModel(ServiceModel):
+    """Decode-aware replica model: a batch pays ``prefill_s`` once (the
+    prompt forward) plus ``per_token_s`` per *output token* per request
+    — roughly batch-size-independent per round, which is the whole
+    point of continuous batching: a decode step over 8 slots costs
+    about the same wall as over 1, so per-request cost collapses as
+    occupancy rises. ``tokens_per_request`` sets the workload's mean
+    output length; the knee finder sweeps offered tokens/s by scaling
+    arrival rate against it."""
+
+    __slots__ = ("prefill_s", "per_token_s", "tokens_per_request")
+
+    def __init__(self, prefill_s: float = 0.004,
+                 per_token_s: float = 0.002,
+                 tokens_per_request: int = 32):
+        super().__init__(base_s=prefill_s, per_item_s=0.0)
+        self.prefill_s = float(prefill_s)
+        self.per_token_s = float(per_token_s)
+        self.tokens_per_request = int(tokens_per_request)
+
+    def batch_s(self, batch_len: int) -> float:
+        # The decode rounds run once per token position regardless of
+        # how many sequences share them; prefill is per-admission but
+        # overlaps the running batch, so only the first one gates.
+        if batch_len <= 0:
+            return self.prefill_s
+        return (self.prefill_s
+                + self.per_token_s * self.tokens_per_request)
+
+
 class SimReplica:
     """One virtual replica: an event-driven dispatcher against the
     real :class:`RequestQueue`."""

@@ -647,6 +647,79 @@ def render_prometheus(view: Dict[str, Any]) -> str:
         "Simulator throughput: virtual events processed per wall "
         "second in the most recent replay.",
     )
+    decode_rounds = _Family(
+        "raydp_decode_rounds_total", "counter",
+        "Decode scheduler rounds executed (one jitted decode step over "
+        "the live batch per round; doc/serving.md, autoregressive "
+        "decode).",
+    )
+    decode_prefills = _Family(
+        "raydp_decode_prefills_total", "counter",
+        "Sequences admitted into KV slots (each admission runs one "
+        "prefill and produces the first token).",
+    )
+    decode_tokens = _Family(
+        "raydp_decode_tokens_total", "counter",
+        "Output tokens produced by the decode rounds (prefill first "
+        "tokens included).",
+    )
+    decode_retired = _Family(
+        "raydp_decode_retired_total", "counter",
+        "Sequences retired from the decode batch by reason "
+        "(eos|length|timeout|cancel|evict).",
+    )
+    decode_evictions = _Family(
+        "raydp_decode_evictions_total", "counter",
+        "Sequences evicted from their KV slot under page pressure — "
+        "recompute preemption: the sequence re-enters the queue as a "
+        "prefill of its generated-so-far context.",
+    )
+    decode_dup_tokens = _Family(
+        "raydp_decode_duplicate_tokens_total", "counter",
+        "Token events discarded by the driver's global-index dedup "
+        "(at-most-once streams under replica failover).",
+    )
+    decode_requeued = _Family(
+        "raydp_decode_requeued_prefills_total", "counter",
+        "In-flight decode sequences returned to the queue as prefills "
+        "after their replica died (the zero-drop failover path at "
+        "token granularity).",
+    )
+    decode_batch_occupancy = _Family(
+        "raydp_decode_batch_occupancy", "gauge",
+        "Live sequences in the decode batch after the most recent "
+        "round (out of RAYDP_TPU_DECODE_SLOTS).",
+    )
+    decode_page_fill = _Family(
+        "raydp_decode_page_fill", "gauge",
+        "Fraction of the KV page budget currently allocated to live "
+        "slots.",
+    )
+    decode_kv_bucket = _Family(
+        "raydp_decode_kv_bucket", "gauge",
+        "KV cache-length bucket the most recent decode round compiled "
+        "for (tightest power-of-two page multiple covering the "
+        "longest live sequence).",
+    )
+    decode_pending = _Family(
+        "raydp_decode_pending", "gauge",
+        "Admitted sequences waiting for a free KV slot on the "
+        "replica.",
+    )
+    decode_tps = _Family(
+        "raydp_decode_tokens_per_second", "gauge",
+        "Output-token throughput of the decode plane since start.",
+    )
+    decode_ttft = _Family(
+        "raydp_decode_ttft_seconds", "histogram",
+        "Time to first token: driver accept to first streamed token "
+        "(cumulative log-spaced buckets).",
+    )
+    decode_tpot = _Family(
+        "raydp_decode_tpot_seconds", "histogram",
+        "Per-output-token latency after the first token "
+        "((wall - ttft) / (n - 1) per finished sequence).",
+    )
     serve_counter_routes = {
         "serve/requests": serve_requests,
         "serve/replies": serve_replies,
@@ -657,6 +730,14 @@ def render_prometheus(view: Dict[str, Any]) -> str:
         "serve/restarts": serve_restarts,
         "serve/batches": serve_batches,
         "serve/batch_requests": serve_batch_requests,
+    }
+    decode_counter_routes = {
+        "decode/rounds": decode_rounds,
+        "decode/prefills": decode_prefills,
+        "decode/tokens": decode_tokens,
+        "decode/evictions": decode_evictions,
+        "decode/dup_tokens": decode_dup_tokens,
+        "decode/requeued_prefills": decode_requeued,
     }
 
     sources: Dict[str, Dict[str, Any]] = dict(view.get("workers") or {})
@@ -873,6 +954,21 @@ def render_prometheus(view: Dict[str, Any]) -> str:
                             {"worker": worker_id}, section[name]
                         )
                         continue
+                    if name in ("decode/rounds", "decode/prefills",
+                                "decode/tokens", "decode/evictions",
+                                "decode/dup_tokens",
+                                "decode/requeued_prefills"):
+                        decode_counter_routes[name].add(
+                            {"worker": worker_id}, section[name]
+                        )
+                        continue
+                    if name.startswith("decode/retired/"):
+                        decode_retired.add(
+                            {"worker": worker_id,
+                             "reason": name[len("decode/retired/"):]},
+                            section[name],
+                        )
+                        continue
                     if name == "loadgen/fired":
                         loadgen_fired.add(
                             {"worker": worker_id}, section[name]
@@ -949,6 +1045,16 @@ def render_prometheus(view: Dict[str, Any]) -> str:
                         autoscale_pool_size.add({"worker": worker_id}, value)
                     elif name == "autoscale/pending_spawns":
                         autoscale_pending.add({"worker": worker_id}, value)
+                    elif name == "decode/batch_occupancy":
+                        decode_batch_occupancy.add(
+                            {"worker": worker_id}, value
+                        )
+                    elif name == "decode/page_fill":
+                        decode_page_fill.add({"worker": worker_id}, value)
+                    elif name == "decode/kv_bucket":
+                        decode_kv_bucket.add({"worker": worker_id}, value)
+                    elif name == "decode/pending":
+                        decode_pending.add({"worker": worker_id}, value)
                     elif name == "serve/queue_depth":
                         serve_queue_depth.add({"worker": worker_id}, value)
                     elif name == "serve/batch_fill":
@@ -994,6 +1100,10 @@ def render_prometheus(view: Dict[str, Any]) -> str:
                     serve_rps.add(
                         {"worker": worker_id}, section.get("per_sec", 0.0)
                     )
+                elif mname == "decode/throughput":
+                    decode_tps.add(
+                        {"worker": worker_id}, section.get("per_sec", 0.0)
+                    )
             elif key.startswith("timer/"):
                 tname = key[len("timer/"):]
                 family = timers
@@ -1024,6 +1134,10 @@ def render_prometheus(view: Dict[str, Any]) -> str:
                         "worker": worker_id,
                         "phase": name[len("serve/phase/"):],
                     }
+                elif name == "decode/ttft":
+                    family, labels = decode_ttft, {"worker": worker_id}
+                elif name == "decode/tpot":
+                    family, labels = decode_tpot, {"worker": worker_id}
                 else:
                     family = generic_hist
                     labels = {"worker": worker_id, "name": name}
@@ -1069,6 +1183,11 @@ def render_prometheus(view: Dict[str, Any]) -> str:
                    serve_queue_depth, serve_batch_fill,
                    serve_replicas_alive, serve_rps, serve_latency,
                    serve_replica_latency, serve_phase,
+                   decode_rounds, decode_prefills, decode_tokens,
+                   decode_retired, decode_evictions, decode_dup_tokens,
+                   decode_requeued, decode_batch_occupancy,
+                   decode_page_fill, decode_kv_bucket, decode_pending,
+                   decode_tps, decode_ttft, decode_tpot,
                    loadgen_fired, loadgen_requests, loadgen_offered_rps,
                    loadgen_achieved_rps, loadgen_knee_rps,
                    events_dropped, slo_status, slo_burn, slo_breaches,

@@ -45,9 +45,11 @@ _EXACT_KEYS = ("mfu", "batch_fill", "knee_rps")
 
 # Substrings that denote a lower-is-better metric (repair/startup
 # latencies from the VERIFY_METRICS.json smoke stamps: preempt MTTR,
-# SLO MTTR, autoscaler time-to-grow). A regression is the metric
-# getting BIGGER.
-_INVERSE_MARKERS = ("mttr_s", "time_to_", "detect_s", "drain_s")
+# SLO MTTR, autoscaler time-to-grow; decode time-to-first-token from
+# the serve_decode section). A regression is the metric getting
+# BIGGER. ``decode_tokens_per_sec`` rides _RATE_MARKERS already.
+_INVERSE_MARKERS = ("mttr_s", "time_to_", "detect_s", "drain_s",
+                    "ttft_")
 
 # Sections of an entry that hold nested telemetry, not results — their
 # numeric leaves (e.g. meter/rows_per_sec gauges) are point-in-time

@@ -472,6 +472,109 @@ stamp("serve_smoke", {
 })
 PYEOF
 fi
+# Decode smoke (HARD): a decode-mode replica group streaming causal-LM
+# tokens under concurrent traffic. Three acts against ONE live group:
+# a serve_kill lands mid-decode and every in-flight sequence must
+# finish token-identical to the in-process reference (the requeue-as-
+# prefill recipe, zero drops); then, warm, the same prompts run
+# batched vs one-request-at-a-time and continuous batching must clear
+# 3x the sequential tokens/s — the end-to-end proof of
+# doc/serving.md's iteration-level scheduling story.
+if [ "$rc" -eq 0 ]; then
+  echo "--- decode smoke (continuous batching + replica kill mid-decode) ---"
+  JAX_PLATFORMS=cpu RAYDP_TPU_FAULT_PLAN="serve_kill:replica=0,request=4" \
+    python - <<'PYEOF' \
+    && echo "DECODE_SMOKE=ok" \
+    || { echo "DECODE_SMOKE=failed"; dump_dashboard; rc=1; }
+import time
+
+from raydp_tpu.serve import ReplicaGroup
+from raydp_tpu.serve.decode import build_transformer_engine
+from raydp_tpu.utils.profiling import metrics
+
+# Same factory the replica rebuilds from (seed-pinned init), so the
+# driver-side reference decodes with byte-identical weights.
+reference = build_transformer_engine(seed=0)
+
+with ReplicaGroup(
+    replicas=1, model_fn=build_transformer_engine, label="smoke-decode",
+    mode="decode", restart_backoff_s=0.2, max_restarts=3,
+    max_queue=64,
+).start() as group:
+    # Act 1 — kill mid-decode. The fault clause trips on the FIFTH
+    # admission (request=4): the first wave of four is already
+    # streaming when the trigger lands, so the driver must requeue
+    # four live sequences as prefills of their generated-so-far
+    # context onto the respawned replica.
+    wave = [[i + 1, i + 2, i + 3] for i in range(4)]
+    reqs = [group.submit_generate(p, max_new=48, timeout_s=240.0)
+            for p in wave]
+    deadline = time.monotonic() + 180.0
+    while time.monotonic() < deadline:
+        if metrics.snapshot()["counters"].get("decode/tokens", 0) >= 4:
+            break
+        time.sleep(0.01)
+    trigger = group.submit_generate([9, 9], max_new=4, timeout_s=240.0)
+    for p, r in zip(wave, reqs):
+        assert r.wait(timeout=240.0)["tokens"] == \
+            reference.reference_decode(p, 48), f"stream diverged for {p}"
+    assert trigger.wait(timeout=240.0)["tokens"] == \
+        reference.reference_decode([9, 9], 4), "trigger stream diverged"
+    mid = group.stats()
+    assert mid["restarts"] >= 1, mid
+    assert mid["decode"]["requeued_prefills"] >= 1, mid
+
+    # Self-heal before timing: the killed lineage back at strength.
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline:
+        if group.stats()["replicas_alive"] == 1:
+            break
+        time.sleep(0.1)
+    assert group.stats()["replicas_alive"] == 1, group.stats()
+
+    # Act 2 — batched: 16 concurrent streams over 8 KV slots (the
+    # second eight join mid-stream as the first wave retires). The
+    # respawned replica is warm by now, so this times scheduling, not
+    # XLA.
+    prompts = [[(i % 7) + 1, 2, 3, 4] for i in range(16)]
+    t0 = time.monotonic()
+    breqs = [group.submit_generate(p, max_new=32, timeout_s=240.0)
+             for p in prompts]
+    batched = [r.wait(timeout=240.0) for r in breqs]
+    batched_wall = time.monotonic() - t0
+    ttfts = sorted(r.ttft_s() for r in breqs)
+    assert all(t is not None for t in ttfts), ttfts
+
+    # Act 3 — the same prompts one-request-at-a-time: the replica's
+    # round cost is fixed by its slot batch, so serving sequentially
+    # wastes it.
+    t0 = time.monotonic()
+    seq = [group.generate(p, max_new=32, timeout_s=240.0)
+           for p in prompts]
+    seq_wall = time.monotonic() - t0
+
+    for i, (b, s) in enumerate(zip(batched, seq)):
+        assert b["tokens"] == s["tokens"], \
+            f"batched/sequential streams diverged for prompt {i}"
+    stats = group.stats()
+
+tokens = sum(len(b["tokens"]) for b in batched)
+tps_batched = tokens / batched_wall
+tps_seq = tokens / seq_wall
+assert tps_batched >= 3.0 * tps_seq, (tps_batched, tps_seq)
+assert stats["errors"] == 0, stats
+assert stats["replies"] == 5 + 16 + 16, stats
+ttft_p99 = ttfts[min(len(ttfts) - 1, int(0.99 * len(ttfts)))]
+
+exec(open("scripts/verify_metrics.py").read())
+stamp("decode_smoke", {
+    "decode_tokens_per_sec": tps_batched,
+    "sequential_tokens_per_sec": tps_seq,
+    "speedup_vs_sequential": tps_batched / tps_seq,
+    "ttft_p99_s": ttft_p99,
+})
+PYEOF
+fi
 # Autoscale smoke (HARD): sustained admission pressure grows a real
 # worker pool within ONE evaluation, the injected spawn_fail:nth=1 is
 # backed off and retried to convergence, idle drains the pool back to

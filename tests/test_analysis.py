@@ -458,6 +458,50 @@ def test_r5_step_loop_host_sync(tmp_path):
     assert res.findings[0].scope.endswith("train_loop")
 
 
+def test_r5_decode_loop_host_sync(tmp_path):
+    res = _run(tmp_path, {"gen.py": """
+        def decode_tokens(engine, seqs, rounds):
+            out = []
+            for _ in range(rounds):
+                toks = engine.step(seqs)
+                for t in toks:
+                    out.append(t.item())
+            return out
+
+        def generate_stream(engine, prompt, n):
+            for _ in range(n):
+                tok = engine.step([prompt])
+                jax.device_get(tok)
+    """}, rules=["R5"])
+    findings = [f for f in res.findings
+                if f.name == "host-sync-in-decode-loop"]
+    assert len(findings) == 2
+    scopes = sorted(f.scope for f in findings)
+    assert scopes[0].endswith("decode_tokens")
+    assert scopes[1].endswith("generate_stream")
+    assert "per token" in findings[0].message
+
+
+def test_r5_decode_loop_good_shapes(tmp_path):
+    res = _run(tmp_path, {"gen.py": """
+        def run_round(engine, batch):
+            # ONE batched fetch per round, outside the per-seq loop
+            toks = list(engine.step(batch))
+            for seq, tok in zip(batch, toks):
+                seq.append(tok)
+            return toks
+
+        def reference_decode(engine, prompt, n):
+            # the unbatched reference path is exempt by name
+            out = []
+            for _ in range(n):
+                out.append(engine.forward(prompt).item())
+            return out
+    """}, rules=["R5"])
+    assert [f for f in res.findings
+            if f.name == "host-sync-in-decode-loop"] == []
+
+
 # -- engine: suppressions, baseline, parse errors -----------------------
 
 _R1_BAD = """

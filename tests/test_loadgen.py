@@ -375,3 +375,95 @@ def test_phase_breakdown_from_records():
         for name in ("queue_wait", "linger", "execute", "reply")
     )
     assert additive == pytest.approx(1.0, abs=0.01)
+
+
+# ---------------------------------------------------------------------
+# Token-level metrics (decode workloads)
+# ---------------------------------------------------------------------
+
+
+def _decode_outcome(idx, latency_s, ttft_s, tokens, requested):
+    from raydp_tpu.loadgen.runner import RequestOutcome
+
+    return RequestOutcome(
+        index=idx, scheduled_t=float(idx), fired_t=float(idx),
+        status="ok", latency_s=latency_s, size=4, bucket=16,
+        ttft_s=ttft_s, tokens=tokens, tokens_requested=requested,
+    )
+
+
+def test_token_metrics_quantiles_and_rates():
+    from raydp_tpu.loadgen.runner import LoadResult
+
+    res = LoadResult(offered_rps=2.0, duration_s=10.0)
+    # 0.1s to first token, then 9 more tokens over 0.9s → TPOT 0.1s
+    res.outcomes = [
+        _decode_outcome(i, 1.0, 0.1, 10, 16) for i in range(4)
+    ]
+    assert res.ttft_quantile(0.5) == pytest.approx(0.1)
+    assert res.tpot_quantile(0.5) == pytest.approx(0.1)
+    assert res.achieved_tokens_per_sec == pytest.approx(4.0)
+    assert res.offered_tokens_per_sec == pytest.approx(6.4)
+    s = res.summary()
+    assert s["tokens"]["achieved_tokens_per_sec"] == pytest.approx(4.0)
+    assert s["tokens"]["ttft_p99_s"] == pytest.approx(0.1)
+    assert s["tokens"]["tpot_p50_s"] == pytest.approx(0.1)
+    rec = res.outcomes[0].to_record()
+    assert rec["ttft_s"] == 0.1 and rec["tokens"] == 10
+    assert rec["tokens_requested"] == 16
+
+
+def test_token_metrics_absent_for_predict_workloads():
+    from raydp_tpu.loadgen.runner import LoadResult, RequestOutcome
+
+    res = LoadResult(offered_rps=1.0, duration_s=1.0)
+    res.outcomes = [RequestOutcome(
+        index=0, scheduled_t=0.0, fired_t=0.0, status="ok",
+        latency_s=0.1, size=4, bucket=16,
+    )]
+    assert res.outcomes[0].tpot_s is None
+    assert res.ttft_quantile(0.5) is None
+    assert "tokens" not in res.summary()
+
+
+def test_group_target_decode_fires_generate():
+    class _Req:
+        request_id = "g-1"
+        phases = {"total": 0.2}
+
+        def wait(self):
+            return {"tokens": [4, 5, 6], "n": 3, "finish_reason": "eos"}
+
+        def ttft_s(self):
+            return 0.05
+
+    class _Group:
+        def __init__(self):
+            self.calls = []
+
+        def submit_generate(self, prompt, max_new, eos, timeout_s):
+            self.calls.append((list(prompt), max_new, eos))
+            return _Req()
+
+    group = _Group()
+    target = GroupTarget(group, decode=True, max_new=8)
+    out = target.fire(TraceEvent(t=0.0, size=3, bucket=16), 5.0)
+    assert out["status"] == "ok"
+    assert out["tokens"] == 3
+    assert out["tokens_requested"] == 8
+    assert out["ttft_s"] == pytest.approx(0.05)
+    assert group.calls[0][1] == 8
+    assert len(group.calls[0][0]) == 3
+
+
+def test_decode_service_model_batch_independent():
+    from raydp_tpu.sim.cluster import DecodeServiceModel, ServiceModel
+
+    m = DecodeServiceModel(prefill_s=0.004, per_token_s=0.002,
+                           tokens_per_request=32)
+    # per-request batching pays per item; decode rounds do not — a
+    # full batch costs the same wall as a single sequence
+    assert m.batch_s(1) == pytest.approx(m.batch_s(8))
+    assert m.batch_s(8) == pytest.approx(0.004 + 0.002 * 32)
+    per_req = ServiceModel(base_s=0.004, per_item_s=0.064)
+    assert per_req.batch_s(8) > 4 * m.batch_s(8)
