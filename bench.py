@@ -1527,13 +1527,18 @@ def bench_etl_shuffle():
         D._AGG_COALESCE_BYTES,
         D._COMBINE_COALESCE_BYTES,
     )
+    saved_aqe = os.environ.get("RAYDP_TPU_AQE")
     session = raydp_tpu.init(app_name="bench-shuffle", num_workers=4)
     try:
         # Defeat the adaptive coalescers so the timings measure real
-        # multi-partition exchanges, not a single-table collapse.
+        # multi-partition exchanges, not a single-table collapse; pin
+        # the runtime replanner OFF for the legacy leaves so their
+        # numbers stay diffable against pre-AQE baselines (the aqe_*
+        # leaves below run the on/off A/B explicitly).
         D._EXCHANGE_COALESCE_BYTES = 0
         D._AGG_COALESCE_BYTES = 0
         D._COMBINE_COALESCE_BYTES = 0
+        os.environ["RAYDP_TPU_AQE"] = "0"
 
         def counters():
             c = metrics.snapshot().get("counters", {})
@@ -1602,8 +1607,9 @@ def bench_etl_shuffle():
         # --- zipfian skewed keys: partition-skew evidence --------------
         # A zipf(1.3) key column concentrates a large fraction of rows
         # in a handful of hash buckets; the stage-stats store reports
-        # the resulting max/mean partition-skew ratio the (future) AQE
-        # would re-plan on.
+        # the resulting max/mean partition-skew ratio the AQE salt rule
+        # replans on (the aqe_* leaves below run that A/B; this leaf
+        # keeps AQE off so it stays diffable against older baselines).
         from raydp_tpu.telemetry.progress import stage_store
 
         zkeys = np.minimum(rng.zipf(1.3, n_rows), 10_000) - 1
@@ -1619,9 +1625,9 @@ def bench_etl_shuffle():
             dt = min(dt, time.perf_counter() - t0)
         # Raw-row exchange (window forces one): the head key's mass
         # lands in one bucket, and the stage stats report the resulting
-        # partition-skew ratio the (future) AQE would re-plan on. The
-        # tiered groupBy above exchanges per-key PARTIALS, which is
-        # exactly why its latency stays flat under key skew.
+        # partition-skew ratio the AQE salt rule replans on. The tiered
+        # groupBy above exchanges per-key PARTIALS, which is exactly
+        # why its latency stays flat under key skew.
         last0 = stage_store.last_id()
         zw = W.Window.partitionBy("k").orderBy("v")
         zdf.withColumn("rn", W.row_number().over(zw))._flush()
@@ -1635,6 +1641,110 @@ def bench_etl_shuffle():
                 max((s.skew for s in zstats), default=1.0), 3
             ),
             "stages": len(zstats),
+        }
+
+        # --- AQE salted-vs-static A/B ----------------------------------
+        # Harder skew (zipf 2.0 puts ~half the mass on the head key),
+        # layout pre-built ONCE under AQE=0 so both arms consume the
+        # identical skewed frame; arms interleave (salted, static,
+        # salted, ...) and report medians, same discipline as the
+        # stage-stats overhead leaf. The parallelism win scales with
+        # cores — on a 1-CPU host the headline is the skew ratio and
+        # the work-unit rebalance, not wall clock.
+        z2 = np.minimum(rng.zipf(2.0, n_rows), 10_000) - 1
+        zskew = rdf.from_pandas(
+            pd.DataFrame({"k": z2, "v": rng.randn(n_rows)}),
+            num_partitions=8,
+        ).withColumn(
+            "rn", W.row_number().over(W.Window.partitionBy("k").orderBy("v"))
+        )._flush()
+        # Strip planner metadata (same partitions): with exchange keys
+        # kept, the static arm would take the tier-0 elided path and
+        # the A/B would compare different plan shapes, not the slicing.
+        zskew = D.DataFrame(zskew._parts, zskew._executor)
+        zrows = [zskew._executor.num_rows(p) for p in zskew._parts]
+        input_skew = (
+            max(zrows) / (sum(zrows) / len(zrows)) if sum(zrows) else 1.0
+        )
+
+        def one_aqe_groupby(aqe_on):
+            os.environ["RAYDP_TPU_AQE"] = "1" if aqe_on else "0"
+            mark = stage_store.last_id()
+            t0 = time.perf_counter()
+            zskew.groupBy("k").agg(("v", "sum"), ("v", "mean")).count()
+            dt = time.perf_counter() - t0
+            # Partial-stage task count: salting slices the hot
+            # partition into extra work units, so parts > n_partitions
+            # is the rebalance fingerprint.
+            parts = max(
+                (s.parts_out for s in stage_store.recent(64)
+                 if s.stage_id > mark and ":partial" in s.op),
+                default=len(zskew._parts),
+            )
+            return dt, parts
+
+        zdim = rdf.from_pandas(rdim, num_partitions=8)
+        zprobe = rdf.from_pandas(
+            pd.DataFrame({"k": z2, "v": rng.randn(n_rows)}),
+            num_partitions=8,
+        )._flush()
+        saved_bcast = D._BROADCAST_JOIN_BYTES
+        D._BROADCAST_JOIN_BYTES = 0  # force the shuffle-join path
+
+        def one_aqe_join(aqe_on):
+            os.environ["RAYDP_TPU_AQE"] = "1" if aqe_on else "0"
+            mark = stage_store.last_id()
+            t0 = time.perf_counter()
+            zprobe.join(zdim, on="k").count()
+            dt = time.perf_counter() - t0
+            # Worst exchange-output skew this run: salting splits the
+            # hot probe bucket, so the salted arm's ratio collapses.
+            sk = max(
+                (s.skew for s in stage_store.recent(64)
+                 if s.stage_id > mark and s.op.startswith("exchange")),
+                default=1.0,
+            )
+            return dt, sk
+
+        try:
+            one_aqe_groupby(True), one_aqe_join(True)  # warm both paths
+            g_on, g_off, j_on, j_off = [], [], [], []
+            gp_on = gp_off = len(zskew._parts)
+            js_on = js_off = 1.0
+            for i in range(6):
+                if i % 2 == 0:
+                    dt, gp_on = one_aqe_groupby(True)
+                    g_on.append(dt)
+                    dt, js_on = one_aqe_join(True)
+                    j_on.append(dt)
+                else:
+                    dt, gp_off = one_aqe_groupby(False)
+                    g_off.append(dt)
+                    dt, js_off = one_aqe_join(False)
+                    j_off.append(dt)
+        finally:
+            D._BROADCAST_JOIN_BYTES = saved_bcast
+            os.environ["RAYDP_TPU_AQE"] = "0"
+        for xs in (g_on, g_off, j_on, j_off):
+            xs.sort()
+        g1, g0 = g_on[len(g_on) // 2], g_off[len(g_off) // 2]
+        j1, j0 = j_on[len(j_on) // 2], j_off[len(j_off) // 2]
+        out["aqe_groupby"] = {
+            "zipf_a": 2.0,
+            "salted_rows_per_sec": round(n_rows / g1, 1),
+            "static_rows_per_sec": round(n_rows / g0, 1),
+            "speedup": round(g0 / g1, 2),
+            "input_skew": round(input_skew, 3),
+            "partial_parts_salted": int(gp_on),
+            "partial_parts_static": int(gp_off),
+        }
+        out["aqe_join"] = {
+            "zipf_a": 2.0,
+            "salted_rows_per_sec": round(n_rows / j1, 1),
+            "static_rows_per_sec": round(n_rows / j0, 1),
+            "speedup": round(j0 / j1, 2),
+            "max_partition_skew_static": round(js_off, 3),
+            "max_partition_skew_salted": round(js_on, 3),
         }
 
         # --- stage-stats overhead: the <5% guarantee -------------------
@@ -1671,6 +1781,10 @@ def bench_etl_shuffle():
             D._AGG_COALESCE_BYTES,
             D._COMBINE_COALESCE_BYTES,
         ) = saved
+        if saved_aqe is None:
+            os.environ.pop("RAYDP_TPU_AQE", None)
+        else:
+            os.environ["RAYDP_TPU_AQE"] = saved_aqe
         raydp_tpu.stop()
     out["unit"] = "rows/s"
     out["host_cpus"] = os.cpu_count()

@@ -18,6 +18,7 @@ import numpy as np
 import pyarrow as pa
 import pyarrow.compute as pc
 
+from raydp_tpu.dataframe import aqe as _aqe
 from raydp_tpu.dataframe import expr as E
 from raydp_tpu.dataframe.executor import (
     Executor,
@@ -26,6 +27,7 @@ from raydp_tpu.dataframe.executor import (
     stage_label,
 )
 from raydp_tpu.dataframe.scheduler import (
+    all_settled as _all_settled,
     chain as _chain_part,
     is_pending as _is_pending,
     resolve as _resolve_parts,
@@ -106,6 +108,12 @@ class DataFrame:
         # pipeline there — fusing the gather with the next stage instead
         # of paying an extra store round-trip for an eager concat.
         self._pending_gather = False
+        # AQE replan marker: the partition layout was rewritten at
+        # runtime (coalesced/salted buckets), so even though
+        # _exchange_keys co-location still holds, bucket i is NOT
+        # hash(keys) % n_out — layout-pairing optimizations (zip join,
+        # one-sided shuffle-join elision) must not trust it.
+        self._aqe_layout = False
         # Memoized schema probe; frames are immutable, so once probed it
         # never changes. Derived frames start unset (None).
         self._schema: Optional[pa.Schema] = None
@@ -123,6 +131,7 @@ class DataFrame:
     ) -> "DataFrame":
         out = DataFrame(self._parts, self._executor, self._pending + [fn])
         out._pending_gather = self._pending_gather
+        out._aqe_layout = self._aqe_layout
         out._lineage = self._lineage + [node or _node("map", lazy=True)]
         return out
 
@@ -130,6 +139,7 @@ class DataFrame:
         """Same frame, one more lineage node (elision / noop records)."""
         out = DataFrame(self._parts, self._executor, self._pending)
         out._pending_gather = self._pending_gather
+        out._aqe_layout = self._aqe_layout
         out._exchange_keys = self._exchange_keys
         out._schema = self._schema
         out._lineage = self._lineage + [node]
@@ -173,6 +183,7 @@ class DataFrame:
                 parts = self._executor.map_partitions(self._parts, run)
         out = DataFrame(parts, self._executor)
         out._exchange_keys = self._exchange_keys  # rows did not move
+        out._aqe_layout = self._aqe_layout
         out._schema = self._schema  # pipeline already reflected in probe
         out._lineage = _resolve_lazy(self._lineage, sids)
         return out
@@ -265,6 +276,7 @@ class DataFrame:
             and (keeps_keys is None or keeps_keys(actual))
             else None
         )
+        out._aqe_layout = base._aqe_layout and out._exchange_keys is not None
         return out
 
     def select(self, *columns: ColumnLike) -> "DataFrame":
@@ -361,15 +373,39 @@ class DataFrame:
             )]
             return out
 
+        # AQE coalesce hook: merging whole buckets preserves key
+        # co-location, so _exchange_keys still holds on the output —
+        # only the canonical bucket↔index pairing is lost (_aqe_layout).
+        # Salting is NEVER legal here: this exchange exists to co-locate
+        # equal keys, which a bucket split would break.
+        dec = _aqe.Decisions()
+        plans: List[Any] = []
+        replan = None
+        if _aqe.aqe_enabled():
+            def replan(bucket_bytes: List[int]):
+                plan = _aqe.plan_exchange(
+                    bucket_bytes,
+                    len(df._parts),
+                    min_parts=max(1, df._executor.default_fanout() // 2),
+                    decisions=dec,
+                )
+                if plan is not None:
+                    plans.append(plan)
+                return plan
+
         with stage_label(f"exchange[{kstr}]") as sids:
             parts = df._executor.exchange(
-                df._parts, _bucket_splitter(list(keys), n_out), n_out
+                df._parts, _bucket_splitter(list(keys), n_out), n_out,
+                replan=replan,
             )
         out = DataFrame(parts, df._executor)
         out._exchange_keys = tuple(keys)
+        out._aqe_layout = bool(plans)
         out._lineage = df._lineage + [_node(
             f"exchange[{kstr}]",
-            annotation=f"hash exchange ({reason}), {n_out} buckets",
+            annotation=(
+                f"hash exchange ({reason}), {n_out} buckets" + dec.suffix()
+            ),
             stage_ids=sids,
         )]
         return out
@@ -621,6 +657,7 @@ class DataFrame:
             )
         out = DataFrame(out_parts, df._executor)
         out._exchange_keys = df._exchange_keys  # prefix of partitions
+        out._aqe_layout = df._aqe_layout
         out._lineage = df._lineage + [
             _node(f"limit[{n}]", stage_ids=sids)
         ]
@@ -710,6 +747,11 @@ class DataFrame:
             and right._exchange_keys == tkeys
             and len(left._parts) == len(right._parts)
             and len(left._parts) > 0
+            # A replanned (coalesced/salted) layout is co-located but no
+            # longer the canonical hash%n_out pairing, so bucket i of
+            # one side need not match bucket i of the other.
+            and not left._aqe_layout
+            and not right._aqe_layout
             and _key_types_match(left, right, keys)
         ):
             if len(left._parts) > 1:
@@ -739,20 +781,47 @@ class DataFrame:
         # not just wrong perf. Large build sides also shuffle
         # (broadcasting would materialize and re-ship them whole —
         # Spark's autoBroadcastJoinThreshold decision).
-        right_bytes = sum(
-            right._executor.part_nbytes(p) for p in right._parts
-        )
-        if (
-            join_type in ("right outer", "full outer")
-            or right_bytes > _BROADCAST_JOIN_BYTES
-        ):
-            return _shuffle_join(left, right, keys, join_type)
+        #
+        # AQE join auto-pick: size the build side from MEASUREMENT —
+        # settled partitions probe ref metadata directly; still-pending
+        # streaming frames fall back to the recorded output bytes of the
+        # stage producing them instead of barriering the pipeline.
+        dec = _aqe.Decisions()
+        semantics_forced = join_type in ("right outer", "full outer")
+        if _aqe.aqe_enabled():
+            right_bytes, src = _aqe.measured_frame_bytes(
+                right._executor, right._parts, right._lineage
+            )
+            if not semantics_forced:
+                strategy = (
+                    "shuffle" if right_bytes > _BROADCAST_JOIN_BYTES
+                    else "broadcast"
+                )
+                dec.record(
+                    "join",
+                    f"{strategy} picked from {src} build side "
+                    f"({right_bytes}B vs {_BROADCAST_JOIN_BYTES}B"
+                    " threshold)",
+                )
+        else:
+            right_bytes = sum(
+                right._executor.part_nbytes(p) for p in right._parts
+            )
+        if semantics_forced or right_bytes > _BROADCAST_JOIN_BYTES:
+            return _shuffle_join(
+                left, right, keys, join_type, decisions=dec
+            )
 
-        right_table = _concat(
-            [right._executor.materialize(p) for p in right._parts]
-        )
-        if isinstance(left._executor, ClusterExecutor):
-            broadcast_ref = left._executor.store.put_arrow_table(right_table)
+        if isinstance(left._executor, ClusterExecutor) and right._parts:
+            # Build the broadcast table in ONE worker-side task (concat
+            # memoized by partition identity, output holder-owned in the
+            # store): the driver never materializes the build side — the
+            # old path pulled every right partition to the driver,
+            # concatenated there, then re-uploaded the result.
+            broadcast_ref = left._executor.run_coalesced(
+                _coerce_parts(right, left._executor), lambda t: t,
+                pre_concat=True,
+            )
 
             def fn(t: pa.Table) -> pa.Table:
                 # Resolved worker-side via the ambient resolver (the
@@ -765,13 +834,18 @@ class DataFrame:
                 return _join_aligned(t, rt, keys, join_type)
 
         else:
+            right_table = _concat(
+                [right._executor.materialize(p) for p in right._parts]
+            )
 
             def fn(t: pa.Table) -> pa.Table:
                 return _join_aligned(t, right_table, keys, join_type)
 
         out = left._with(fn, _node(
             f"join[{','.join(keys)}]",
-            annotation=f"broadcast right side ({right_bytes}B)",
+            annotation=(
+                f"broadcast right side ({right_bytes}B)" + dec.suffix()
+            ),
             lazy=True,
         ))
         # Broadcast joins don't move left rows; left's partitioning (its
@@ -1318,7 +1392,31 @@ class GroupedData:
         from raydp_tpu.dataframe.window import keys_cover
 
         label = f"groupBy[{','.join(keys)}].agg"
-        if keys_cover(df._exchange_keys, keys) and not df._pending_gather:
+        # -- AQE skew rebalance (rule: salt) ----------------------------
+        # When the measured input layout is skewed, a per-partition plan
+        # (tier 0/1) serializes on the hot partition. Replace each hot
+        # partition with k zero-copy row slices and commit to the
+        # two-phase partial→merge plan: slices stay in partition order,
+        # so order-sensitive partials (collect_list) merge identically
+        # and EVERY agg spec stays bit-identical to the static plan.
+        # Probe only settled partitions (ref metadata, no materialize);
+        # still-streaming frames keep the static plan.
+        aqe_dec = _aqe.Decisions()
+        rebalance = None
+        in_rows: List[int] = []
+        if (
+            _aqe.aqe_enabled()
+            and len(df._parts) > 1
+            and not df._pending_gather
+            and _all_settled(df._parts)
+        ):
+            in_rows = [df._executor.num_rows(p) for p in df._parts]
+            rebalance = _aqe.plan_rebalance(
+                [df._executor.part_nbytes(p) for p in df._parts], in_rows
+            )
+        if rebalance is None and keys_cover(
+            df._exchange_keys, keys
+        ) and not df._pending_gather:
             was_elided = len(df._parts) > 1
             if was_elided:
                 metrics.counter_add("shuffle/elided")
@@ -1356,7 +1454,11 @@ class GroupedData:
         total_bytes = sum(
             df._executor.part_nbytes(p) for p in df._parts
         )
-        if total_bytes <= _AGG_COALESCE_BYTES and _direct_agg_supported(specs):
+        if (
+            rebalance is None
+            and total_bytes <= _AGG_COALESCE_BYTES
+            and _direct_agg_supported(specs)
+        ):
             keys_ = list(keys)
             specs_ = list(specs)
 
@@ -1388,8 +1490,49 @@ class GroupedData:
         # to ~groups × partitions rows), THEN size the shuffle from the
         # measured partial sizes: small partials merge in one task; big
         # ones hash-exchange across the full fan-out.
-        with stage_label(f"{label}:partial") as sids_p:
-            partials = df._executor.map_partitions(df._parts, partial_fn)
+        if rebalance is not None:
+            aqe_dec.record(
+                "salt",
+                f"sliced {len(rebalance)} hot partition(s) into "
+                f"{sum(rebalance.values())} partial slices"
+                " (two-phase agg)",
+            )
+            metrics.counter_add("aqe/salted_keys", len(rebalance))
+            # Expanded parts repeat a hot partition's handle k times; a
+            # ranges map turns repeat j into the j-th zero-copy row
+            # slice inside the partial task itself (no new executor
+            # surface, and cluster locality routing still sees the
+            # original ref).
+            expanded: List[Any] = []
+            ranges: Dict[int, Tuple[int, int]] = {}
+            for i, p in enumerate(df._parts):
+                k = rebalance.get(i, 0)
+                if k <= 1:
+                    expanded.append(p)
+                    continue
+                base_rows, extra = divmod(in_rows[i], k)
+                off = 0
+                for j in range(k):
+                    size = base_rows + (1 if j < extra else 0)
+                    ranges[len(expanded)] = (off, size)
+                    expanded.append(p)
+                    off += size
+
+            def sliced_partial(t: pa.Table, idx: int) -> pa.Table:
+                r = ranges.get(idx)
+                if r is not None:
+                    t = t.slice(r[0], r[1])
+                return partial_fn(t)
+
+            with stage_label(f"{label}:partial") as sids_p:
+                partials = df._executor.map_partitions_indexed(
+                    expanded, sliced_partial
+                )
+        else:
+            with stage_label(f"{label}:partial") as sids_p:
+                partials = df._executor.map_partitions(
+                    df._parts, partial_fn
+                )
         partial_bytes = sum(
             df._executor.part_nbytes(p) for p in partials
         )
@@ -1412,14 +1555,32 @@ class GroupedData:
                 label,
                 annotation=(
                     f"coalesced: {partial_bytes}B of partials merged"
-                    " in 1 task"
+                    " in 1 task" + aqe_dec.suffix()
                 ),
                 stage_ids=sids_p + sids_m,
             )]
             return out
+        # AQE coalesce hook on the partial exchange (salting is illegal
+        # here: the per-bucket combine must see whole key groups).
+        plans: List[Any] = []
+        replan = None
+        if _aqe.aqe_enabled():
+            n_in = len(partials)
+
+            def replan(bucket_bytes: List[int]):
+                plan = _aqe.plan_exchange(
+                    bucket_bytes,
+                    n_in,
+                    min_parts=max(1, df._executor.default_fanout() // 2),
+                    decisions=aqe_dec,
+                )
+                if plan is not None:
+                    plans.append(plan)
+                return plan
+
         with stage_label(f"{label}:exchange") as sids_x:
             parts = df._executor.exchange(
-                partials, splitter, n_out, combine
+                partials, splitter, n_out, combine, replan=replan
             )
         df._executor.discard(partials)
         out = DataFrame(parts, df._executor)
@@ -1427,10 +1588,12 @@ class GroupedData:
         # output row stays in its bucket, so the result is hash-
         # partitioned on them — downstream wide ops on these keys elide.
         out._exchange_keys = tuple(keys)
+        out._aqe_layout = bool(plans)
         out._lineage = df._lineage + [_node(
             label,
             annotation=(
                 f"hash exchange of partials, {n_out} buckets"
+                + aqe_dec.suffix()
             ),
             stage_ids=sids_p + sids_x,
         )]
@@ -1496,6 +1659,22 @@ def _render_plan(lineage: List[Dict[str, Any]], analyze: bool) -> str:
         f"== Exchanges == ran: {exchanges}, elided: {elided},"
         f" coalesced: {coalesced}"
     )
+    # AQE footer: marker counts per rule, rendered ONLY when a replan
+    # fired so static plans (and RAYDP_TPU_AQE=0 runs) are unchanged.
+    # Counting the aqe[...] markers — not a separate tally — keeps the
+    # footer structurally equal to the raydp_aqe_replans_total counters.
+    aqe_counts = _aqe.rule_counts(
+        "\n".join(n.get("annotation", "") for n in lineage)
+    )
+    if aqe_counts:
+        lines.append(
+            "== AQE == "
+            + ", ".join(
+                f"{rule}: {aqe_counts[rule]}"
+                for rule in _aqe.RULES
+                if rule in aqe_counts
+            )
+        )
     return "\n".join(lines)
 
 
@@ -1718,7 +1897,11 @@ _BROADCAST_JOIN_BYTES = _env_bytes(
 
 
 def _shuffle_join(
-    left: "DataFrame", right: "DataFrame", keys: List[str], join_type: str
+    left: "DataFrame",
+    right: "DataFrame",
+    keys: List[str],
+    join_type: str,
+    decisions: Optional["_aqe.Decisions"] = None,
 ) -> "DataFrame":
     """Shuffle hash join: both sides exchange on the join keys with the
     SAME bucketing, then bucket i joins bucket i (Spark's
@@ -1728,14 +1911,26 @@ def _shuffle_join(
     One-sided elision: when ONE side is already hash-partitioned on
     exactly these keys, only the other side exchanges — into the
     partitioned side's fanout, with its key dtypes (the bucket function
-    must be identical on both sides)."""
+    must be identical on both sides).
+
+    AQE (both-sides branch only — one-sided elision must reproduce the
+    partitioned side's existing layout bucket-for-bucket): the probe
+    (left) exchange may coalesce small buckets and, for join types where
+    replicating build rows is sound, split a hot bucket across k
+    sub-buckets; the build (right) exchange then runs the CONFORMED
+    plan — same merges, split→replicate — so pair i of the zipped merge
+    still joins identical key sets."""
     tkeys = tuple(keys)
     kstr = ",".join(keys)
+    dec = decisions if decisions is not None else _aqe.Decisions()
     lparts: List[Any] = []
     rparts: List[Any] = []
     l_tmp = r_tmp = True  # whether the part lists are exchange temps
     nodes: List[Dict[str, Any]] = []
-    if left._exchange_keys == tkeys and left._parts and _key_types_match(
+    salted = replanned = False
+    if left._exchange_keys == tkeys and left._parts and (
+        not left._aqe_layout
+    ) and _key_types_match(
         left, right, keys
     ):
         # Left already bucketed → re-bucket only the right, to left's
@@ -1761,7 +1956,9 @@ def _shuffle_join(
             ),
             stage_ids=sids,
         ))
-    elif right._exchange_keys == tkeys and right._parts and _key_types_match(
+    elif right._exchange_keys == tkeys and right._parts and (
+        not right._aqe_layout
+    ) and _key_types_match(
         left, right, keys
     ):
         n_out = len(right._parts)
@@ -1794,15 +1991,45 @@ def _shuffle_join(
         )
         sch = left.schema  # one _peek: schema access materializes a probe
         left_schema = {k: sch.field(k).type for k in keys}
+        # Probe-side split + build-side replicate conserves the join
+        # result only when unmatched BUILD rows never surface (they
+        # would be emitted once per sub-bucket otherwise).
+        salt_ok = join_type in (
+            "inner", "left outer", "left semi", "left anti"
+        )
+        plans: List[Any] = []
+        lreplan = None
+        if _aqe.aqe_enabled():
+            n_in = len(left._parts)
+
+            def lreplan(bucket_bytes: List[int]):
+                plan = _aqe.plan_exchange(
+                    bucket_bytes,
+                    n_in,
+                    allow_salt=salt_ok,
+                    min_parts=max(1, left._executor.default_fanout() // 2),
+                    decisions=dec,
+                )
+                if plan is not None:
+                    plans.append(plan)
+                return plan
+
         with stage_label(f"exchange[{kstr}]") as sids:
             lparts = left._executor.exchange(
-                left._parts, _bucket_splitter(keys, n_out), n_out
+                left._parts, _bucket_splitter(keys, n_out), n_out,
+                replan=lreplan,
             )
+            rreplan = None
+            if plans:
+                rreplan = lambda _bb: plans[0].conform_build_side()
             rparts = left._executor.exchange(
                 _coerce_parts(right, left._executor),
                 _bucket_splitter(keys, n_out, cast_to=left_schema),
                 n_out,
+                replan=rreplan,
             )
+        replanned = bool(plans)
+        salted = replanned and plans[0].has_splits()
         nodes.append(_node(
             f"exchange[{kstr}]",
             annotation=f"hash exchange (both sides), {n_out} buckets",
@@ -1816,14 +2043,22 @@ def _shuffle_join(
         parts = left._executor.map_pairs(lparts, rparts, join_pair)
     tmp = (lparts if l_tmp else []) + (rparts if r_tmp else [])
     if tmp:
+        # A replicated build bucket is the SAME object k times in
+        # rparts; discard deletes by ref, so dedupe by identity or the
+        # k-1 extra deletes would race/KeyError.
+        tmp = list({id(p): p for p in tmp}.values())
         # Streaming join tasks fetch lparts/rparts asynchronously —
         # free the temporaries only once every output has settled.
         _when_settled(parts, lambda: left._executor.discard(tmp))
     out = DataFrame(parts, left._executor)
-    out._exchange_keys = tkeys
+    # A salted (split) probe bucket spreads one key's rows across k
+    # output partitions — co-location no longer holds, so downstream
+    # wide ops must not elide on it.
+    out._exchange_keys = None if salted else tkeys
+    out._aqe_layout = replanned and not salted
     out._lineage = left._lineage + nodes + [_node(
         f"join[{kstr}]",
-        annotation=f"shuffle hash join ({join_type})",
+        annotation=f"shuffle hash join ({join_type})" + dec.suffix(),
         stage_ids=jids,
     )]
     return out

@@ -375,9 +375,20 @@ class Executor:
         splitter: Callable[[pa.Table], List[pa.Table]],
         n_out: int,
         combine: Optional[StageFn] = None,
+        replan: Optional[Callable[[List[int]], Any]] = None,
     ) -> List[Any]:
         """All-to-all: split every partition into n_out chunks, then
-        concatenate chunk i across partitions into output partition i."""
+        concatenate chunk i across partitions into output partition i.
+
+        ``replan`` is the AQE hook: called with the measured per-bucket
+        byte sizes AFTER the split phase and BEFORE merge dispatch — the
+        one point where the true shuffle layout is known but nothing has
+        been merged yet. It returns an
+        :class:`raydp_tpu.dataframe.aqe.ExchangePlan` (or ``None`` to
+        keep the static layout); the executor then builds output
+        partitions group-by-group instead of one-per-bucket. ``split``
+        groups are only legal with ``combine=None`` (a per-bucket
+        combine over a sub-bucket would see partial groups)."""
         raise NotImplementedError
 
     def part_nbytes(self, part: Any) -> int:
@@ -437,6 +448,22 @@ class Executor:
     def default_fanout(self) -> int:
         """How many output partitions a shuffle should target."""
         return 8
+
+
+def _split_groups(items: List[Any], k: int) -> List[List[Any]]:
+    """Distribute one bucket's per-input chunk list over ``k``
+    contiguous, non-empty groups (AQE skew splitting). Contiguous in
+    input order so sub-bucket contents stay deterministic run-to-run;
+    ``plan_exchange`` clamps ``k`` to the input-partition count, the
+    ``min`` here is belt-and-braces."""
+    k = max(1, min(k, len(items)))
+    base, extra = divmod(len(items), k)
+    groups, offset = [], 0
+    for i in range(k):
+        size = base + (1 if i < extra else 0)
+        groups.append(items[offset:offset + size])
+        offset += size
+    return groups
 
 
 def _concat(tables: List[pa.Table]) -> pa.Table:
@@ -537,7 +564,7 @@ class LocalExecutor(Executor):
             rec.finish(outs)
             return outs
 
-    def exchange(self, parts, splitter, n_out, combine=None):
+    def exchange(self, parts, splitter, n_out, combine=None, replan=None):
         # Wide stage: every input partition feeds every output bucket,
         # so this is a true barrier — resolve pendings up front.
         parts = resolve(parts)
@@ -554,10 +581,37 @@ class LocalExecutor(Executor):
             # Single host: every chunk is already local to its merge.
             metrics.counter_add("shuffle/local_bytes", moved)
             _acct.add_usage(_acct.SHUFFLE_BYTES, moved)
+            plan = None
+            if replan is not None:
+                plan = replan([
+                    sum(chunks[i].nbytes for chunks in chunked)
+                    for i in range(n_out)
+                ])
             outs = []
-            for i in range(n_out):
-                merged = _concat([chunks[i] for chunks in chunked])
-                outs.append(combine(merged) if combine else merged)
+            if plan is None:
+                for i in range(n_out):
+                    merged = _concat([chunks[i] for chunks in chunked])
+                    outs.append(combine(merged) if combine else merged)
+                    rec.task_done()
+                rec.finish(outs)
+                return outs
+            for g in plan.groups:
+                if g[0] == "merge":
+                    # Bucket-major order: a group of one bucket is
+                    # byte-identical to the static merge of that bucket.
+                    merged = _concat(
+                        [chunks[i] for i in g[1] for chunks in chunked]
+                    )
+                    outs.append(combine(merged) if combine else merged)
+                elif g[0] == "replicate":
+                    merged = _concat([chunks[g[1]] for chunks in chunked])
+                    merged = combine(merged) if combine else merged
+                    outs.extend([merged] * g[2])
+                else:  # ("split", id, k): combine is None by contract
+                    for grp in _split_groups(
+                        [chunks[g[1]] for chunks in chunked], g[2]
+                    ):
+                        outs.append(_concat(grp))
                 rec.task_done()
             rec.finish(outs)
             return outs
@@ -835,7 +889,7 @@ class ClusterExecutor(Executor):
         )
         return wid, node
 
-    def exchange(self, parts, splitter, n_out, combine=None):
+    def exchange(self, parts, splitter, n_out, combine=None, replan=None):
         def split_task(ctx, ref):
             table = ctx.get_table(ref)
             return [ctx.put_table(chunk, holder=True) for chunk in splitter(table)]
@@ -924,20 +978,51 @@ class ClusterExecutor(Executor):
                     for i in range(n_out)
                 ]
 
-            specs, merge_inputs = [], []
+            # AQE replan: only over the deterministic layout — the eager
+            # path already traded bucket order for overlap and its refs
+            # are partially pre-merged, so the measured per-bucket sizes
+            # would double-count. Each group of the returned plan becomes
+            # one (or, for splits, k) merge task(s); every split ref is
+            # still consumed by exactly one merge, so the byte counters
+            # and per-merge freeing below are unchanged.
+            plan = None
+            if replan is not None and eager_min == 0:
+                plan = replan([
+                    sum(r.size for r in refs if isinstance(r, ObjectRef))
+                    for refs in inputs
+                ])
+            if plan is None:
+                groups = [("merge", [i]) for i in range(n_out)]
+            else:
+                groups = plan.groups
+
+            specs, merge_inputs, repeats = [], [], []
             total_b = local_b = 0
-            for i, refs in enumerate(inputs):
-                wid, node = self._merge_worker(i, refs)
-                for r in refs:
-                    if isinstance(r, ObjectRef):
-                        total_b += r.size
-                        if node is not None and r.node_id == node:
-                            local_b += r.size
-                specs.append(
-                    TaskSpec(merge_task, (refs,), worker_id=wid,
-                             node_id=node)
-                )
-                merge_inputs.append(refs)
+            for g in groups:
+                if g[0] == "merge":
+                    # Bucket-major ref order: singleton groups reproduce
+                    # the static merge exactly.
+                    batches = [[r for i in g[1] for r in inputs[i]]]
+                    rep = 1
+                elif g[0] == "replicate":
+                    batches = [inputs[g[1]]]
+                    rep = g[2]
+                else:  # ("split", id, k): combine is None by contract
+                    batches = _split_groups(inputs[g[1]], g[2])
+                    rep = 1
+                for refs in batches:
+                    wid, node = self._merge_worker(len(specs), refs)
+                    for r in refs:
+                        if isinstance(r, ObjectRef):
+                            total_b += r.size
+                            if node is not None and r.node_id == node:
+                                local_b += r.size
+                    specs.append(
+                        TaskSpec(merge_task, (refs,), worker_id=wid,
+                                 node_id=node)
+                    )
+                    merge_inputs.append(refs)
+                    repeats.append(rep)
             metrics.counter_add("shuffle/bytes", total_b)
             metrics.counter_add("shuffle/local_bytes", local_b)
             _acct.add_usage(_acct.SHUFFLE_BYTES, total_b)
@@ -954,7 +1039,10 @@ class ClusterExecutor(Executor):
                 f.add_done_callback(
                     lambda fut, rr=refs: self._free_refs(rr)
                 )
-            outs = [f.result() for f in merge_futures]
+            outs = []
+            for f, rep in zip(merge_futures, repeats):
+                ref = f.result()
+                outs.extend([ref] * rep)
             rec.finish(outs)
             return outs
 

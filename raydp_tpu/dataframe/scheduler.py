@@ -102,6 +102,19 @@ def resolve(parts: Sequence[Any]) -> List[Any]:
     return [resolve_one(p) for p in parts]
 
 
+def all_settled(parts: Sequence[Any]) -> bool:
+    """Whether every partition is already concrete (or its producing
+    task has landed). The AQE's probe guard: replanning from live
+    partition sizes is only free when nothing is in flight — probing a
+    pending partition would resolve it and barrier the streaming
+    pipeline, so skew probes skip frames that are still streaming and
+    fall back to recorded stage stats instead."""
+    return not any(
+        isinstance(p, PendingPartition) and not p.future.done()
+        for p in parts
+    )
+
+
 def when_settled(parts: Sequence[Any], callback: Callable[[], None]) -> None:
     """Run ``callback`` once every partition in ``parts`` has settled
     (resolved or failed); immediately when none is pending. Used to

@@ -307,6 +307,29 @@ def render_prometheus(view: Dict[str, Any]) -> str:
         "Exchanges skipped by the co-partitioning planner because the "
         "frame's existing hash partitioning already co-located the keys.",
     )
+    aqe_replans = _Family(
+        "raydp_aqe_replans_total", "counter",
+        "Adaptive-query-engine replan decisions, per rule "
+        "(rule=coalesce|salt|join|scan). Each bump has exactly one "
+        "matching aqe[<rule>] annotation in the plan explain(analyze) "
+        "renders — the explain/Prometheus parity invariant.",
+    )
+    aqe_coalesced = _Family(
+        "raydp_aqe_coalesced_partitions_total", "counter",
+        "Post-shuffle buckets merged away by the AQE coalesce rule "
+        "(measured bytes below RAYDP_TPU_AQE_TARGET_PARTITION_MB).",
+    )
+    aqe_salted = _Family(
+        "raydp_aqe_salted_keys_total", "counter",
+        "Hot buckets/partitions the AQE salt rule split across "
+        "sub-parts (layout skew above RAYDP_TPU_AQE_SKEW_RATIO).",
+    )
+    aqe_bytes_saved = _Family(
+        "raydp_aqe_bytes_saved_total", "counter",
+        "Compressed parquet bytes the AQE scan rule avoided reading: "
+        "skipped column chunks plus row groups pruned from footer "
+        "min/max statistics.",
+    )
     pipeline_overlap = _Family(
         "raydp_pipeline_overlap_seconds_total", "counter",
         "Wall seconds during which ETL partition tasks and training "
@@ -806,6 +829,28 @@ def render_prometheus(view: Dict[str, Any]) -> str:
                             {"worker": worker_id}, section[name]
                         )
                         continue
+                    if name.startswith("aqe/replans/"):
+                        # One series per replan rule, mirroring the
+                        # aqe[<rule>] plan annotations one-for-one.
+                        aqe_replans.add(
+                            {"worker": worker_id,
+                             "rule": name[len("aqe/replans/"):]},
+                            section[name],
+                        )
+                        continue
+                    if name == "aqe/coalesced_partitions":
+                        aqe_coalesced.add(
+                            {"worker": worker_id}, section[name]
+                        )
+                        continue
+                    if name == "aqe/salted_keys":
+                        aqe_salted.add({"worker": worker_id}, section[name])
+                        continue
+                    if name == "aqe/bytes_saved":
+                        aqe_bytes_saved.add(
+                            {"worker": worker_id}, section[name]
+                        )
+                        continue
                     if name.startswith("stage/"):
                         # Per-stage runtime stats recorded by the
                         # DataFrame executors: stage/<kind>/<op label>.
@@ -1165,8 +1210,9 @@ def render_prometheus(view: Dict[str, Any]) -> str:
     lines: List[str] = []
     for family in (up, counters, meter_total, meter_rate, timers, dropped,
                    stalls, rpc_payload, shuffle_bytes, shuffle_local,
-                   shuffles_elided, pipeline_overlap, stage_rows,
-                   stage_bytes, stage_seconds,
+                   shuffles_elided, pipeline_overlap,
+                   aqe_replans, aqe_coalesced, aqe_salted, aqe_bytes_saved,
+                   stage_rows, stage_bytes, stage_seconds,
                    compiles, compile_seconds, compile_failures,
                    restarts, preemptions, replay_steps, worker_restarts,
                    usage_total, job_chip_seconds, job_task_seconds,

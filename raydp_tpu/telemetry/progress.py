@@ -1,8 +1,9 @@
 """Query-profiling substrate: per-stage runtime stats + live progress.
 
 Two driver-side singletons feed EXPLAIN ANALYZE, the new Prometheus
-families, `/debug/progress`, and (next arc) the cost-based adaptive
-planner:
+families, `/debug/progress`, and the cost-based adaptive planner
+(:mod:`raydp_tpu.dataframe.aqe` reads measured layouts back through
+``StageStatsStore.output_bytes``/``output_layout``):
 
 * :data:`stage_store` — a :class:`StageStatsStore` of
   :class:`StageStats` records, one per executed DataFrame stage
@@ -177,6 +178,32 @@ class StageStatsStore:
                 "wall_s": round(sum(s.wall_s for s in stats), 6),
             },
         }
+
+    # -- stats feedback (the AQE's read path) --------------------------
+    def output_bytes(self, stage_ids: List[int]) -> Optional[int]:
+        """Measured output bytes of the LAST recorded stage among
+        ``stage_ids`` — a plan node's stages run in id order (partial →
+        exchange → ...), so the highest id's output is the layout the
+        node actually produced. ``None`` when none has recorded yet
+        (still streaming, or evicted): the caller falls back to probing
+        partitions directly."""
+        with self._mu:
+            for sid in sorted(stage_ids, reverse=True):
+                s = self._stats.get(sid)
+                if s is not None:
+                    return s.bytes_out
+        return None
+
+    def output_layout(self, stage_ids: List[int]) -> Optional[List[int]]:
+        """Per-partition output bytes of the last recorded stage among
+        ``stage_ids`` (same selection as :meth:`output_bytes`) — the
+        skew evidence replan rules consume."""
+        with self._mu:
+            for sid in sorted(stage_ids, reverse=True):
+                s = self._stats.get(sid)
+                if s is not None:
+                    return list(s.part_bytes)
+        return None
 
     def clear(self) -> None:
         with self._mu:

@@ -41,7 +41,7 @@ from typing import Any, Dict, Optional, Tuple
 
 # Substrings of leaf keys that denote a higher-is-better metric.
 _RATE_MARKERS = ("per_sec",)
-_EXACT_KEYS = ("mfu", "batch_fill", "knee_rps")
+_EXACT_KEYS = ("mfu", "batch_fill", "knee_rps", "aqe_speedup")
 
 # Substrings that denote a lower-is-better metric (repair/startup
 # latencies from the VERIFY_METRICS.json smoke stamps: preempt MTTR,

@@ -337,6 +337,60 @@ def test_r4_docs_satisfy_parity(tmp_path):
     assert res.findings == []
 
 
+def test_r4_aqe_prefix_routing_clean(tmp_path):
+    # The AQE emits aqe/replans/<rule> through an f-string; the export
+    # module's startswith route plus documented families keep R4 quiet
+    # (this is the shape raydp_tpu/telemetry/export.py actually ships).
+    res = _run(tmp_path, {"export.py": """
+        class _Family:
+            def __init__(self, name, kind):
+                self.name = name
+
+        _REPLANS = _Family("raydp_aqe_replans_total", "counter")
+        _SAVED = _Family("raydp_aqe_bytes_saved_total", "counter")
+
+        def route(name):
+            if name.startswith("aqe/replans/"):
+                return _REPLANS
+            if name == "aqe/bytes_saved":
+                return _SAVED
+            return None
+    """, "planner.py": """
+        def replan(metrics, rule, saved):
+            metrics.counter_add(f"aqe/replans/{rule}")
+            metrics.counter_add("aqe/bytes_saved", saved)
+    """}, rules=["R4"], docs={
+        "telemetry.md": "`raydp_aqe_replans_total` counts replan "
+                        "decisions per rule; `raydp_aqe_bytes_saved_total` "
+                        "counts parquet bytes the scan rule skipped.",
+    })
+    assert res.findings == []
+
+
+def test_r4_unrouted_aqe_emit_fires(tmp_path):
+    # An aqe/* emit with no matching route in export.py must fire —
+    # the family set alone is not enough, the name has to route.
+    res = _run(tmp_path, {"export.py": """
+        class _Family:
+            def __init__(self, name, kind):
+                self.name = name
+
+        _REPLANS = _Family("raydp_aqe_replans_total", "counter")
+
+        def route(name):
+            if name.startswith("aqe/replans/"):
+                return _REPLANS
+            return None
+    """, "planner.py": """
+        def replan(metrics, rule, merged):
+            metrics.counter_add(f"aqe/replans/{rule}")
+            metrics.counter_add("aqe/coalesced_partitions", merged)
+    """}, rules=["R4"], docs={"t.md": "raydp_aqe_replans_total"})
+    bad = [f for f in res.findings if f.name == "unrouted-metric"]
+    assert len(bad) == 1
+    assert "aqe/coalesced_partitions" in bad[0].message
+
+
 def test_r4_resolves_module_constants(tmp_path):
     res = _run(tmp_path, {"export.py": """
         class _Family:

@@ -1,0 +1,427 @@
+"""Adaptive query engine (AQE) tests: replan-rule parity against the
+static planner, the RAYDP_TPU_AQE=0 kill switch, and the
+explain-annotation <-> raydp_aqe_* counter parity invariant.
+
+Layout note: local-executor tests come first; the 2-worker cluster
+fixture is module-scoped and only instantiated by the cluster tests at
+the bottom, so everything above runs on LocalExecutor.
+"""
+import os
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import raydp_tpu.dataframe as rdf
+from raydp_tpu.dataframe import aqe as _aqe
+from raydp_tpu.dataframe import col
+from raydp_tpu.dataframe import dataframe as D
+from raydp_tpu.dataframe.executor import LocalExecutor
+from raydp_tpu.dataframe.io import ParquetScanFrame, _distribute, read_parquet
+from raydp_tpu.telemetry.progress import stage_store
+from raydp_tpu.utils.profiling import metrics
+
+
+def _counters():
+    return dict(metrics.snapshot().get("counters", {}))
+
+
+def _aqe_deltas(before, after):
+    out = {}
+    for rule in _aqe.RULES:
+        key = f"aqe/replans/{rule}"
+        d = after.get(key, 0) - before.get(key, 0)
+        if d:
+            out[rule] = int(d)
+    return out
+
+
+def _skewed_tables(seed=7, hot_rows=4000, cold_rows=400, n_cold=3):
+    """One hot partition + n_cold small ones; int/float/null/empty-group
+    coverage. Keys 0..9 live everywhere, keys 100+ ONLY in the hot
+    partition (so salted slices must merge them back correctly), and
+    key None exercises null-group aggregation."""
+    rng = np.random.RandomState(seed)
+
+    def make(n, keys):
+        k = rng.choice(keys, n).astype(object)
+        k[rng.rand(n) < 0.05] = None  # null keys
+        return pa.table({
+            "k": pa.array(list(k), type=pa.int64()),
+            "i": pa.array(rng.randint(0, 1000, n), type=pa.int64()),
+            "f": pa.array(
+                np.where(rng.rand(n) < 0.1, np.nan, rng.randn(n))
+            ),
+        })
+
+    hot = make(hot_rows, list(range(10)) + [100, 101])
+    colds = [make(cold_rows, list(range(10))) for _ in range(n_cold)]
+    return [hot] + colds
+
+
+def _agg_frame(df):
+    return (
+        df.groupBy("k")
+        .agg(("i", "sum"), ("i", "count"), ("f", "sum"),
+             ("i", "collect_list"))
+        .to_pandas()
+        .sort_values("k", na_position="last")
+        .reset_index(drop=True)
+    )
+
+
+def _assert_agg_equal(a, b):
+    assert list(a.columns) == list(b.columns)
+    assert len(a) == len(b)
+    # Integer aggregates and list order are bit-identical across plans;
+    # float sums may differ by reassociation ulps (NaN==NaN via equal_nan).
+    assert a["k"].fillna(-1).tolist() == b["k"].fillna(-1).tolist()
+    assert a["sum(i)"].tolist() == b["sum(i)"].tolist()
+    assert a["count(i)"].tolist() == b["count(i)"].tolist()
+    np.testing.assert_allclose(
+        a["sum(f)"].astype(float), b["sum(f)"].astype(float),
+        rtol=1e-9, equal_nan=True,
+    )
+    for la, lb in zip(a["collect_list(i)"], b["collect_list(i)"]):
+        assert list(la) == list(lb)
+
+
+def test_groupby_salt_parity(monkeypatch):
+    monkeypatch.setenv("RAYDP_TPU_AQE_MIN_EXCHANGE_MB", "0.0001")
+    tables = _skewed_tables()
+
+    monkeypatch.setenv("RAYDP_TPU_AQE", "0")
+    static = _agg_frame(_distribute(list(tables), LocalExecutor()))
+
+    monkeypatch.setenv("RAYDP_TPU_AQE", "1")
+    before = _counters()
+    df = _distribute(list(tables), LocalExecutor())
+    out = df.groupBy("k").agg(
+        ("i", "sum"), ("i", "count"), ("f", "sum"), ("i", "collect_list")
+    )
+    salted = (
+        out.to_pandas().sort_values("k", na_position="last")
+        .reset_index(drop=True)
+    )
+    _assert_agg_equal(salted, static)
+    text = out.explain(quiet=True)
+    assert "aqe[salt]" in text
+    deltas = _aqe_deltas(before, _counters())
+    assert deltas.get("salt", 0) >= 1
+
+
+def test_groupby_salt_skips_below_floor(monkeypatch):
+    # Same skewed layout, but the exchange floor stays at its 4 MB
+    # default: the replanner must leave tiny frames alone.
+    monkeypatch.setenv("RAYDP_TPU_AQE", "1")
+    monkeypatch.delenv("RAYDP_TPU_AQE_MIN_EXCHANGE_MB", raising=False)
+    df = _distribute(_skewed_tables(), LocalExecutor())
+    out = df.groupBy("k").agg(("i", "sum"))
+    out.to_pandas()
+    assert "aqe[" not in out.explain(quiet=True)
+
+
+def test_exchange_coalesce_parity(monkeypatch):
+    monkeypatch.setenv("RAYDP_TPU_AQE_MIN_EXCHANGE_MB", "0.0001")
+    monkeypatch.setattr(D, "_EXCHANGE_COALESCE_BYTES", 0)
+    rng = np.random.RandomState(3)
+    pdf = pd.DataFrame({
+        "k": rng.randint(0, 50, 5000),
+        "v": rng.randn(5000),
+    })
+
+    def run():
+        df = rdf.from_pandas(pdf, num_partitions=8)
+        out = df.distinct()
+        return out, (
+            out.to_pandas().sort_values(["k", "v"]).reset_index(drop=True)
+        )
+
+    monkeypatch.setenv("RAYDP_TPU_AQE", "0")
+    _, static = run()
+    monkeypatch.setenv("RAYDP_TPU_AQE", "1")
+    before = _counters()
+    out, adaptive = run()
+    pd.testing.assert_frame_equal(adaptive, static)
+    text = out.explain(quiet=True)
+    assert "aqe[coalesce]" in text
+    after = _counters()
+    assert _aqe_deltas(before, after).get("coalesce", 0) >= 1
+    assert after.get("aqe/coalesced_partitions", 0) > before.get(
+        "aqe/coalesced_partitions", 0
+    )
+
+
+def _join_inputs(seed=11):
+    rng = np.random.RandomState(seed)
+    n = 6000
+    # ~60% of probe rows carry key 0 (one hot hash bucket), plus nulls
+    # (never match) and keys 900+ missing from the build side.
+    k = np.where(rng.rand(n) < 0.6, 0, rng.randint(1, 950, n)).astype(object)
+    k[rng.rand(n) < 0.03] = None
+    probe = pd.DataFrame({
+        "k": pd.array(list(k), dtype="Int64"),
+        "v": rng.randn(n),
+    })
+    build = pd.DataFrame({
+        "k": pd.Series(np.arange(900), dtype="Int64"),
+        "dim": rng.randn(900),
+    })
+    return probe, build
+
+
+@pytest.mark.parametrize("how", ["inner", "left"])
+def test_join_salt_parity(monkeypatch, how):
+    monkeypatch.setenv("RAYDP_TPU_AQE_MIN_EXCHANGE_MB", "0.0001")
+    monkeypatch.setattr(D, "_BROADCAST_JOIN_BYTES", 0)
+    monkeypatch.setattr(D, "_EXCHANGE_COALESCE_BYTES", 0)
+    # 1-CPU hosts default to a fanout of 2, which leaves no room for
+    # bucket splitting; widen it so the salt rule has sub-buckets.
+    monkeypatch.setattr(LocalExecutor, "default_fanout", lambda self: 8)
+    probe_pdf, build_pdf = _join_inputs()
+
+    def run():
+        probe = rdf.from_pandas(probe_pdf, num_partitions=6)
+        build = rdf.from_pandas(build_pdf, num_partitions=4)
+        out = probe.join(build, on="k", how=how)
+        res = (
+            out.to_pandas().sort_values(["k", "v", "dim"])
+            .reset_index(drop=True)
+        )
+        return out, res
+
+    monkeypatch.setenv("RAYDP_TPU_AQE", "0")
+    _, static = run()
+    monkeypatch.setenv("RAYDP_TPU_AQE", "1")
+    before = _counters()
+    out, salted = run()
+    pd.testing.assert_frame_equal(salted, static)
+    text = out.explain(quiet=True)
+    assert "aqe[salt]" in text
+    assert _aqe_deltas(before, _counters()).get("salt", 0) >= 1
+    # A salted layout is no longer hash(keys) % n: the frame must not
+    # advertise co-location downstream.
+    assert out._exchange_keys is None
+    assert not out._aqe_layout
+
+
+def test_join_strategy_measured_annotation(monkeypatch):
+    monkeypatch.setenv("RAYDP_TPU_AQE", "1")
+    probe = rdf.from_pandas(
+        pd.DataFrame({"k": np.arange(500) % 50, "v": np.arange(500.0)}),
+        num_partitions=4,
+    )
+    build = rdf.from_pandas(
+        pd.DataFrame({"k": np.arange(50), "dim": np.arange(50.0)}),
+        num_partitions=2,
+    )
+    out = probe.join(build, on="k")
+    text = out.explain(quiet=True)
+    # The build side is measured BEFORE the strategy commits (the old
+    # cold path materialized first and sized second): the annotation
+    # carries the measured bytes and the threshold it beat.
+    assert "aqe[join]" in text
+    assert "broadcast picked from measured build side" in text
+    assert out.count() == 500
+
+
+def test_scan_pushdown_parity(tmp_path, monkeypatch):
+    t = pa.table({
+        "id": np.arange(20_000, dtype=np.int64),
+        "v": np.random.RandomState(0).rand(20_000),
+        "w": np.random.RandomState(1).rand(20_000),
+    })
+    path = str(tmp_path / "scan.parquet")
+    pq.write_table(t, path, row_group_size=2000)
+
+    monkeypatch.setenv("RAYDP_TPU_AQE", "0")
+    static = (
+        read_parquet(path).select("id", "v").filter(col("id") < 5000)
+        .to_arrow()
+    )
+
+    monkeypatch.setenv("RAYDP_TPU_AQE", "1")
+    before = _counters()
+    df = read_parquet(path)
+    assert isinstance(df, ParquetScanFrame)
+    # Schema probes must answer from footer metadata without scanning.
+    assert df.columns == ["id", "v", "w"]
+    assert df._realized is None
+    q = df.select("id", "v").filter(col("id") < 5000)
+    pushed = q.to_arrow()
+    assert pushed.equals(static)
+    text = q.explain(quiet=True)
+    assert "aqe[scan]" in text
+    assert "row group(s) pruned" in text
+    after = _counters()
+    assert _aqe_deltas(before, after).get("scan", 0) == 1
+    assert after.get("aqe/bytes_saved", 0) > before.get(
+        "aqe/bytes_saved", 0
+    )
+
+
+def test_scan_pushdown_all_rows_pruned(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAYDP_TPU_AQE", "1")
+    t = pa.table({"id": np.arange(1000, dtype=np.int64)})
+    path = str(tmp_path / "p.parquet")
+    pq.write_table(t, path, row_group_size=100)
+    out = read_parquet(path).filter(col("id") < -1).to_arrow()
+    assert out.num_rows == 0
+    assert out.schema.names == ["id"]
+
+
+def test_scan_pushdown_filter_col_projected_away(tmp_path, monkeypatch):
+    # A filter pushed BEFORE a select may reference a column the
+    # projection then drops — the scan must still read it for the
+    # predicate and only project afterwards.
+    t = pa.table({
+        "id": np.arange(10_000, dtype=np.int64),
+        "v": np.random.RandomState(0).rand(10_000),
+    })
+    path = str(tmp_path / "scan.parquet")
+    pq.write_table(t, path, row_group_size=1000)
+
+    monkeypatch.setenv("RAYDP_TPU_AQE", "0")
+    static = (
+        read_parquet(path).filter(col("id") < 3000).select("v").to_arrow()
+    )
+
+    monkeypatch.setenv("RAYDP_TPU_AQE", "1")
+    q = read_parquet(path).filter(col("id") < 3000).select("v")
+    pushed = q.to_arrow()
+    assert pushed.column_names == ["v"]
+    assert pushed.equals(static)
+    text = q.explain(quiet=True)
+    assert "aqe[scan]" in text
+    assert "row group(s) pruned" in text
+
+
+def test_kill_switch_static_bit_for_bit(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAYDP_TPU_AQE", "0")
+    monkeypatch.setenv("RAYDP_TPU_AQE_MIN_EXCHANGE_MB", "0.0001")
+    monkeypatch.setattr(D, "_EXCHANGE_COALESCE_BYTES", 0)
+    t = pa.table({"id": np.arange(3000, dtype=np.int64),
+                  "v": np.arange(3000, dtype=np.int64) % 7})
+    path = str(tmp_path / "k.parquet")
+    pq.write_table(t, path, row_group_size=500)
+
+    before = _counters()
+    df = read_parquet(path)
+    assert not isinstance(df, ParquetScanFrame)
+    out = df.filter(col("id") >= 100).distinct()
+    agg = _distribute(_skewed_tables(), LocalExecutor()).groupBy("k").agg(
+        ("i", "sum")
+    )
+    text = out.explain(quiet=True) + agg.explain(quiet=True)
+    assert "aqe[" not in text
+    after = _counters()
+    assert _aqe_deltas(before, after) == {}
+    for key in ("aqe/coalesced_partitions", "aqe/salted_keys",
+                "aqe/bytes_saved"):
+        assert after.get(key, 0) == before.get(key, 0)
+
+
+def test_annotation_counter_parity(tmp_path, monkeypatch):
+    """THE parity invariant: every aqe[<rule>] marker in the rendered
+    plan corresponds to exactly one aqe/replans/<rule> counter bump."""
+    monkeypatch.setenv("RAYDP_TPU_AQE", "1")
+    monkeypatch.setenv("RAYDP_TPU_AQE_MIN_EXCHANGE_MB", "0.0001")
+    monkeypatch.setattr(D, "_EXCHANGE_COALESCE_BYTES", 0)
+    t = pa.table({
+        "k": np.arange(8000, dtype=np.int64) % 40,
+        "v": np.random.RandomState(5).rand(8000),
+    })
+    path = str(tmp_path / "parity.parquet")
+    pq.write_table(t, path, row_group_size=1000)
+
+    before = _counters()
+    q = (
+        read_parquet(path)
+        .filter(col("k") < 30)
+        .distinct()  # raw exchange: coalesce rule territory
+    )
+    text = q.explain(analyze=True, quiet=True)
+    after = _counters()
+    marks = _aqe.rule_counts(text)
+    assert marks, "expected at least one replan in this pipeline"
+    for rule in _aqe.RULES:
+        assert marks.get(rule, 0) == after.get(
+            f"aqe/replans/{rule}", 0
+        ) - before.get(f"aqe/replans/{rule}", 0), rule
+    # The footer summarizes the same counts.
+    assert "== AQE ==" in text
+
+
+# -- 2-worker cluster ---------------------------------------------------
+
+@pytest.fixture(scope="module")
+def session():
+    import raydp_tpu
+
+    s = raydp_tpu.init(app_name="aqetest", num_workers=2,
+                       memory_per_worker="256MB")
+    yield s
+    raydp_tpu.stop()
+
+
+def _zipfish(n, seed):
+    rng = np.random.RandomState(seed)
+    k = np.where(rng.rand(n) < 0.6, 0, rng.randint(1, 900, n))
+    return pd.DataFrame({"k": k.astype(np.int64), "v": rng.randn(n)})
+
+
+def test_cluster_zipfian_join_salt_reduces_skew(session, monkeypatch):
+    monkeypatch.setenv("RAYDP_TPU_AQE_MIN_EXCHANGE_MB", "0.05")
+    monkeypatch.setattr(D, "_BROADCAST_JOIN_BYTES", 0)
+    monkeypatch.setattr(D, "_EXCHANGE_COALESCE_BYTES", 0)
+    probe_pdf = _zipfish(120_000, seed=23)
+    build_pdf = pd.DataFrame({
+        "k": np.arange(900, dtype=np.int64),
+        "dim": np.random.RandomState(1).randn(900),
+    })
+
+    def run(aqe):
+        monkeypatch.setenv("RAYDP_TPU_AQE", aqe)
+        probe = rdf.from_pandas(probe_pdf, num_partitions=4)
+        build = rdf.from_pandas(build_pdf, num_partitions=4)
+        mark = stage_store.last_id()
+        out = probe.join(build, on="k")
+        n = out.count()
+        skew = max(
+            (s.skew for s in stage_store.recent(64)
+             if s.stage_id > mark and s.op.startswith("exchange")),
+            default=1.0,
+        )
+        return n, skew, out.explain(quiet=True)
+
+    n0, static_skew, _ = run("0")
+    n1, salted_skew, text = run("1")
+    assert n0 == n1
+    assert "aqe[salt]" in text
+    # The hot hash bucket dominates the static layout; the salted plan
+    # splits it below the replan threshold.
+    assert static_skew > _aqe.skew_ratio()
+    assert salted_skew < static_skew
+    assert salted_skew < _aqe.skew_ratio()
+
+
+def test_cluster_groupby_salt_parity(session, monkeypatch):
+    monkeypatch.setenv("RAYDP_TPU_AQE_MIN_EXCHANGE_MB", "0.0001")
+    tables = _skewed_tables(seed=29)
+
+    monkeypatch.setenv("RAYDP_TPU_AQE", "0")
+    static = _agg_frame(_distribute(list(tables)))
+
+    monkeypatch.setenv("RAYDP_TPU_AQE", "1")
+    df = _distribute(list(tables))
+    out = df.groupBy("k").agg(
+        ("i", "sum"), ("i", "count"), ("f", "sum"), ("i", "collect_list")
+    )
+    salted = (
+        out.to_pandas().sort_values("k", na_position="last")
+        .reset_index(drop=True)
+    )
+    _assert_agg_equal(salted, static)
+    assert "aqe[salt]" in out.explain(quiet=True)
